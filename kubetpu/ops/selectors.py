@@ -1,0 +1,203 @@
+"""Batched label-selector matching as dense tensor ops.
+
+This is the TPU-native replacement for the reference's per-object
+``labels.Selector.Matches`` calls scattered across every plugin
+(reference: staging/src/k8s.io/apimachinery/pkg/labels/selector.go,
+pkg/scheduler/framework/plugins/*/): a *selector* is compiled host-side
+into multi-hot vectors over the interned (key,value) / key vocabularies,
+and matching S selectors against M targets (nodes or pods) becomes two
+batched matmuls on the MXU plus elementwise logic — no per-object string
+work on the hot path.
+
+Semantics per requirement (AND across requirements of one selector):
+  In(key, vals)      -> target has any interned (key,v) for v in vals
+  NotIn(key, vals)   -> negation of In  (key absent also matches)
+  Exists(key)        -> target has the key
+  DoesNotExist(key)  -> negation of Exists
+  Gt/Lt(key, val)    -> numeric parse of the target's label value compared
+                        to val; unparsable/missing never matches
+matching apimachinery's Requirement.Matches (selector.go:214-260).
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..api import types as api
+from ..utils.intern import InternTable, pow2_bucket
+
+
+class SelectorSet(NamedTuple):
+    """S compiled selectors, each an AND of up to Q requirements.
+
+    vals_hot : [S, Q, L] f32 multi-hot over (key,value) vocab (In/NotIn)
+    key_hot  : [S, Q, K] f32 multi-hot over key vocab (Exists/DoesNotExist)
+    negate   : [S, Q] bool    requirement result is inverted
+    use_key  : [S, Q] bool    requirement tests key presence, not values
+    req_valid: [S, Q] bool    padding mask for requirements
+    num_key  : [S, Q] i32     key index for Gt/Lt (0 if unused)
+    num_op   : [S, Q] i32     0 = none, 1 = Gt, 2 = Lt
+    num_val  : [S, Q] f32     comparison constant for Gt/Lt
+    sel_valid: [S] bool       padding mask for selectors (invalid => caller
+                              decides; match_selectors returns False rows)
+    """
+    vals_hot: jnp.ndarray
+    key_hot: jnp.ndarray
+    negate: jnp.ndarray
+    use_key: jnp.ndarray
+    req_valid: jnp.ndarray
+    num_key: jnp.ndarray
+    num_op: jnp.ndarray
+    num_val: jnp.ndarray
+    sel_valid: jnp.ndarray
+
+    @property
+    def n_selectors(self) -> int:
+        return self.vals_hot.shape[0]
+
+
+def match_selectors(sel: SelectorSet,
+                    kv: jnp.ndarray,      # [M, L] bool/float — target has (key,value)
+                    key: jnp.ndarray,     # [M, K] bool/float — target has key
+                    num: Optional[jnp.ndarray] = None,  # [M, K] f32 numeric label values (NaN = non-numeric)
+                    ) -> jnp.ndarray:
+    """Match S selectors against M targets -> [S, M] bool.
+
+    The two einsums are batched matmuls; everything else fuses into them.
+    """
+    kv_f = kv.astype(jnp.float32)
+    key_f = key.astype(jnp.float32)
+    cnt_v = jnp.einsum("sql,ml->sqm", sel.vals_hot, kv_f,
+                       preferred_element_type=jnp.float32)
+    cnt_k = jnp.einsum("sqk,mk->sqm", sel.key_hot, key_f,
+                       preferred_element_type=jnp.float32)
+    present = jnp.where(sel.use_key[..., None], cnt_k > 0.5, cnt_v > 0.5)
+    ok = present ^ sel.negate[..., None]
+
+    if num is not None:
+        # Gt/Lt: gather each requirement's numeric label column.
+        nval = jnp.take(num.T, jnp.clip(sel.num_key, 0, num.shape[1] - 1),
+                        axis=0)  # [S, Q, M]
+        is_gt = sel.num_op[..., None] == 1
+        cmp = jnp.where(is_gt, nval > sel.num_val[..., None],
+                        nval < sel.num_val[..., None])
+        cmp = jnp.logical_and(cmp, jnp.logical_not(jnp.isnan(nval)))
+        ok = jnp.where(sel.num_op[..., None] > 0, cmp, ok)
+
+    ok = jnp.logical_or(ok, jnp.logical_not(sel.req_valid[..., None]))
+    return jnp.logical_and(jnp.all(ok, axis=1), sel.sel_valid[:, None])
+
+
+# ---------------------------------------------------------------------------
+# host-side compiler
+
+
+SelectorLike = Union[api.LabelSelector, api.NodeSelectorTerm, dict, None]
+
+# Synthetic label-key prefix for NodeSelectorTerm.match_fields (the only
+# supported field is metadata.name, reference:
+# pkg/apis/core/v1/helper/helpers.go GetNodeFieldSelectorMap).
+FIELD_PREFIX = "__field__"
+
+
+class _Req(NamedTuple):
+    op: str
+    key: str
+    values: Sequence[str]
+
+
+def _reqs_of(sel: SelectorLike) -> Optional[List[_Req]]:
+    """Normalize any selector-ish object to a requirement list; None => the
+    selector matches nothing (nil selector)."""
+    if sel is None:
+        return None
+    if isinstance(sel, dict):  # plain match-labels map (e.g. spec.nodeSelector)
+        return [_Req("In", k, [v]) for k, v in sorted(sel.items())]
+    if isinstance(sel, api.LabelSelector):
+        return [_Req(r.operator, r.key, list(r.values)) for r in sel.requirements()]
+    if isinstance(sel, api.NodeSelectorTerm):
+        reqs = [_Req(r.operator, r.key, list(r.values)) for r in sel.match_expressions]
+        reqs += [_Req(r.operator, FIELD_PREFIX + r.key, list(r.values))
+                 for r in sel.match_fields]
+        # A term with no expressions and no fields matches nothing
+        # (reference: pkg/apis/core/v1/helper/helpers.go:180 MatchNodeSelectorTerms).
+        if not reqs:
+            return None
+        return reqs
+    raise TypeError(f"unsupported selector type {type(sel)}")
+
+
+class SelectorCompiler:
+    """Compiles host selector objects into a SelectorSet of numpy arrays."""
+
+    def __init__(self, table: InternTable):
+        self.table = table
+
+    def compile(self, selectors: Sequence[SelectorLike],
+                pad_s: Optional[int] = None,
+                intern_new: bool = True) -> SelectorSet:
+        """intern_new: selectors may introduce vocab entries (normally the
+        snapshot builder has already interned all cluster labels; pod
+        selectors referencing unknown values simply never match, so lookups
+        use get() when intern_new=False)."""
+        req_lists = [_reqs_of(s) for s in selectors]
+        max_q = max((len(r) for r in req_lists if r), default=1)
+        Q = pow2_bucket(max_q, 2)
+        S = pad_s if pad_s is not None else pow2_bucket(len(selectors), 1)
+        if S < len(selectors):
+            raise ValueError("pad_s smaller than selector count")
+        L, K = self.table.kv.cap, self.table.key.cap
+
+        vals_hot = np.zeros((S, Q, L), np.float32)
+        key_hot = np.zeros((S, Q, K), np.float32)
+        negate = np.zeros((S, Q), bool)
+        use_key = np.zeros((S, Q), bool)
+        req_valid = np.zeros((S, Q), bool)
+        num_key = np.zeros((S, Q), np.int32)
+        num_op = np.zeros((S, Q), np.int32)
+        num_val = np.zeros((S, Q), np.float32)
+        sel_valid = np.zeros((S,), bool)
+
+        kv_id = (self.table.kv.intern if intern_new else self.table.kv.get)
+        key_id = (self.table.key.intern if intern_new else self.table.key.get)
+
+        for i, reqs in enumerate(req_lists):
+            if reqs is None:
+                continue  # matches nothing
+            sel_valid[i] = True
+            for q, r in enumerate(reqs):
+                req_valid[i, q] = True
+                if r.op in ("In", "NotIn"):
+                    for v in r.values:
+                        j = kv_id((r.key, v))
+                        if j >= 0:
+                            vals_hot[i, q, j] = 1.0
+                    negate[i, q] = (r.op == "NotIn")
+                elif r.op in ("Exists", "DoesNotExist"):
+                    j = key_id(r.key)
+                    if j >= 0:
+                        key_hot[i, q, j] = 1.0
+                    use_key[i, q] = True
+                    negate[i, q] = (r.op == "DoesNotExist")
+                elif r.op in ("Gt", "Lt"):
+                    j = key_id(r.key)
+                    num_key[i, q] = max(j, 0)
+                    num_op[i, q] = 1 if r.op == "Gt" else 2
+                    try:
+                        num_val[i, q] = float(int(r.values[0]))
+                    except (ValueError, IndexError):
+                        # unparsable constant never matches: impossible compare
+                        num_op[i, q] = 1
+                        num_val[i, q] = np.inf
+                    if j < 0:
+                        # unknown key can never be numeric-matched
+                        num_val[i, q] = np.inf if r.op == "Gt" else -np.inf
+                else:
+                    raise ValueError(f"unknown selector op {r.op}")
+
+        return SelectorSet(vals_hot=vals_hot, key_hot=key_hot, negate=negate,
+                           use_key=use_key, req_valid=req_valid, num_key=num_key,
+                           num_op=num_op, num_val=num_val, sel_valid=sel_valid)

@@ -1,0 +1,420 @@
+"""Cluster snapshot tensorization: NodeInfos -> dense device arrays.
+
+This is the TPU-native analog of the reference's scheduler cache snapshot
+(reference: pkg/scheduler/internal/cache/snapshot.go:29 Snapshot,
+cache.go:202 UpdateSnapshot): instead of a list of NodeInfo pointers handed
+to 16 goroutines, the cluster becomes a struct-of-arrays over the node axis
+(plus an existing-pods axis for affinity/spread) that one jitted program
+consumes.  All strings are interned (kubetpu/utils/intern.py); all set
+membership is multi-hot.
+
+Unit conventions (chosen so every value the scheduler compares is exact in
+f32 — see kubetpu/api/resource.py):
+  channel 0: CPU millicores          (raw int value)
+  channel 1: memory MiB              (bytes / 2^20; exact for Mi-granular values)
+  channel 2: ephemeral-storage MiB
+  channel 3: pod count / max pods
+  channel 4+: scalar (extended) resources, raw integer value, one channel
+              per interned resource name.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..api import types as api
+from ..api.resource import Resource
+from ..framework.types import NodeInfo, PodInfo
+from ..ops.selectors import FIELD_PREFIX, SelectorCompiler, SelectorSet
+from ..utils.intern import InternTable, pow2_bucket
+
+MIB = float(2 ** 20)
+
+# fixed channels
+CH_CPU, CH_MEM, CH_EPH, CH_PODS = 0, 1, 2, 3
+N_FIXED_CHANNELS = 4
+
+# taint effect codes
+EFFECT_CODES = {api.TAINT_EFFECT_NO_SCHEDULE: 0,
+                api.TAINT_EFFECT_PREFER_NO_SCHEDULE: 1,
+                api.TAINT_EFFECT_NO_EXECUTE: 2}
+
+
+def resource_to_channels(r: Resource, table: InternTable, R: int,
+                         intern_new: bool = True) -> np.ndarray:
+    out = np.zeros((R,), np.float32)
+    out[CH_CPU] = r.milli_cpu
+    out[CH_MEM] = r.memory / MIB
+    out[CH_EPH] = r.ephemeral_storage / MIB
+    out[CH_PODS] = r.allowed_pod_number
+    for name, v in r.scalar_resources.items():
+        i = table.rname.intern(name) if intern_new else table.rname.get(name)
+        ch = N_FIXED_CHANNELS + i
+        if 0 <= i and ch < R:
+            out[ch] = v
+    return out
+
+
+class ExistingTerms(NamedTuple):
+    """Flattened (anti-)affinity terms owned by *existing* pods, matched
+    against incoming pods.  Two instances live in ClusterTensors: one for
+    filtering (required anti-affinity of existing pods, reference:
+    interpodaffinity/filtering.go:166 getExistingAntiAffinityCounts) and one
+    for scoring (preferred +w / -w and required-affinity x hardWeight,
+    reference: interpodaffinity/scoring.go:128 processExistingPod)."""
+    sel: SelectorSet           # [Et] selectors over incoming-pod labels
+    ns_hot: jnp.ndarray        # [Et, NS] f32 — namespaces the term applies to
+    topo_key: jnp.ndarray      # [Et] i32 index into topokey axis
+    pod_idx: jnp.ndarray       # [Et] i32 owning existing-pod row
+    weight: jnp.ndarray        # [Et] f32 (signed; 1.0 for filter terms)
+    valid: jnp.ndarray         # [Et] bool
+
+
+class ClusterTensors(NamedTuple):
+    """One immutable device-side cluster snapshot (a JAX pytree)."""
+    # node axis ------------------------------------------------------------
+    allocatable: jnp.ndarray        # [N, R] f32
+    requested: jnp.ndarray          # [N, R] f32
+    nonzero_requested: jnp.ndarray  # [N, 2] f32 (cpu milli, mem MiB)
+    node_valid: jnp.ndarray         # [N] bool
+    unschedulable: jnp.ndarray      # [N] bool (.spec.unschedulable)
+    kv: jnp.ndarray                 # [N, L] bool — node has label (k,v)
+    keymask: jnp.ndarray            # [N, K] bool — node has label key
+    num: jnp.ndarray                # [N, K] f32 — numeric label value (NaN if not)
+    topo_pair: jnp.ndarray          # [N, TK] i32 — kv id of (topokey, value), -1 absent
+    taints: jnp.ndarray             # [N, T] bool
+    ports: jnp.ndarray              # [N, P] bool
+    images: jnp.ndarray             # [N, I] bool
+    avoid_pods: jnp.ndarray         # [N, 2] bool — preferAvoidPods annotation present
+                                    #   for (ReplicationController, ReplicaSet) owners
+    # vocab-side metadata ---------------------------------------------------
+    taint_is_hard: jnp.ndarray      # [T] bool (NoSchedule | NoExecute)
+    taint_is_prefer: jnp.ndarray    # [T] bool (PreferNoSchedule)
+    image_size: jnp.ndarray         # [I] f32 bytes
+    image_spread: jnp.ndarray       # [I] f32 fraction of nodes having the image
+    # existing pods axis ----------------------------------------------------
+    pod_kv: jnp.ndarray             # [P, L] bool
+    pod_key: jnp.ndarray            # [P, K] bool
+    pod_ns_hot: jnp.ndarray         # [P, NS] f32 one-hot
+    pod_node: jnp.ndarray           # [P] i32 node row (-1 invalid)
+    pod_valid: jnp.ndarray          # [P] bool
+    # existing pods' terms --------------------------------------------------
+    filter_terms: ExistingTerms     # required anti-affinity (filter)
+    score_terms: ExistingTerms      # preferred +/-, required x hardWeight (score)
+
+    @property
+    def n_nodes_cap(self) -> int:
+        return self.allocatable.shape[0]
+
+
+class HostClusterArrays(NamedTuple):
+    """Numpy twin of ClusterTensors (what the builder maintains)."""
+    arrays: dict
+
+    def to_device(self) -> ClusterTensors:
+        d = self.arrays
+        ft = d["filter_terms"]
+        st = d["score_terms"]
+        def put(x):
+            return jnp.asarray(x)
+        return ClusterTensors(
+            allocatable=put(d["allocatable"]), requested=put(d["requested"]),
+            nonzero_requested=put(d["nonzero_requested"]),
+            node_valid=put(d["node_valid"]), unschedulable=put(d["unschedulable"]),
+            kv=put(d["kv"]), keymask=put(d["keymask"]), num=put(d["num"]),
+            topo_pair=put(d["topo_pair"]), taints=put(d["taints"]),
+            ports=put(d["ports"]), images=put(d["images"]),
+            avoid_pods=put(d["avoid_pods"]),
+            taint_is_hard=put(d["taint_is_hard"]),
+            taint_is_prefer=put(d["taint_is_prefer"]),
+            image_size=put(d["image_size"]), image_spread=put(d["image_spread"]),
+            pod_kv=put(d["pod_kv"]), pod_key=put(d["pod_key"]),
+            pod_ns_hot=put(d["pod_ns_hot"]), pod_node=put(d["pod_node"]),
+            pod_valid=put(d["pod_valid"]),
+            filter_terms=ExistingTerms(*[put(x) if not isinstance(x, SelectorSet)
+                                         else SelectorSet(*[put(y) for y in x])
+                                         for x in ft]),
+            score_terms=ExistingTerms(*[put(x) if not isinstance(x, SelectorSet)
+                                        else SelectorSet(*[put(y) for y in x])
+                                        for x in st]),
+        )
+
+
+# Well-known topology keys are always present so zone/hostname spreading
+# needs no vocab growth (reference: pkg/apis/core/v1/well_known_labels.go).
+SEED_TOPOKEYS = (api.LABEL_HOSTNAME, api.LABEL_ZONE, api.LABEL_REGION,
+                 api.LABEL_ZONE_LEGACY, api.LABEL_REGION_LEGACY)
+
+
+class SnapshotBuilder:
+    """Builds HostClusterArrays from a list of NodeInfos.
+
+    Mirrors the roles of snapshot.go:49 (NewSnapshot) — including the
+    HavePodsWithAffinityList secondary index, which here becomes the
+    flattened ExistingTerms tensors.  DefaultHardPodAffinityWeight = 1
+    (reference: apis/config/v1beta1/defaults.go hardPodAffinityWeight).
+    """
+
+    def __init__(self, table: Optional[InternTable] = None,
+                 hard_pod_affinity_weight: int = 1):
+        self.table = table or InternTable()
+        for k in SEED_TOPOKEYS:
+            self.table.topokey.intern(k)
+        self.hard_pod_affinity_weight = hard_pod_affinity_weight
+        self.compiler = SelectorCompiler(self.table)
+
+    # -- interning helpers --------------------------------------------------
+
+    def _intern_node_strings(self, nodes: List[NodeInfo]) -> None:
+        """First pass: make sure vocab contains everything in the cluster so
+        bucket caps are final before array allocation."""
+        t = self.table
+        for ni in nodes:
+            node = ni.node
+            if node is None:
+                continue
+            for k, v in node.metadata.labels.items():
+                t.kv.intern((k, v)); t.key.intern(k)
+            t.kv.intern((FIELD_PREFIX + "metadata.name", node.name))
+            t.key.intern(FIELD_PREFIX + "metadata.name")
+            for taint in node.spec.taints:
+                t.taint.intern((taint.key, taint.value, taint.effect))
+            for name in ni.image_states:
+                t.image.intern(_norm_image(name))
+            for r in ni.allocatable.scalar_resources:
+                t.rname.intern(r)
+            for triple in ni.used_ports:
+                for pid in _port_ids_node(triple):
+                    t.port.intern(pid)
+            for pi in ni.pods:
+                p = pi.pod
+                t.ns.intern(p.namespace)
+                for k, v in p.metadata.labels.items():
+                    t.kv.intern((k, v)); t.key.intern(k)
+                for term in (pi.required_anti_affinity_terms
+                             + [w.term for w in pi.preferred_affinity_terms]
+                             + [w.term for w in pi.preferred_anti_affinity_terms]
+                             + pi.required_affinity_terms):
+                    t.topokey.intern(term.topology_key)
+                    for ns in term.namespaces:
+                        t.ns.intern(ns)
+
+    # -- build --------------------------------------------------------------
+
+    def build(self, nodes: List[NodeInfo]) -> HostClusterArrays:
+        self._intern_node_strings(nodes)
+        t = self.table
+        N = pow2_bucket(len(nodes), 8)
+        R = N_FIXED_CHANNELS + t.rname.cap
+        L, K, TK = t.kv.cap, t.key.cap, t.topokey.cap
+        T, P, I, NS = t.taint.cap, t.port.cap, t.image.cap, t.ns.cap
+        n_pods = sum(len(ni.pods) for ni in nodes)
+        PP = pow2_bucket(n_pods, 8)
+
+        d: dict = {
+            "allocatable": np.zeros((N, R), np.float32),
+            "requested": np.zeros((N, R), np.float32),
+            "nonzero_requested": np.zeros((N, 2), np.float32),
+            "node_valid": np.zeros((N,), bool),
+            "unschedulable": np.zeros((N,), bool),
+            "kv": np.zeros((N, L), bool),
+            "keymask": np.zeros((N, K), bool),
+            "num": np.full((N, K), np.nan, np.float32),
+            "topo_pair": np.full((N, TK), -1, np.int32),
+            "taints": np.zeros((N, T), bool),
+            "ports": np.zeros((N, P), bool),
+            "images": np.zeros((N, I), bool),
+            "avoid_pods": np.zeros((N, 2), bool),
+            "taint_is_hard": np.zeros((T,), bool),
+            "taint_is_prefer": np.zeros((T,), bool),
+            "image_size": np.zeros((I,), np.float32),
+            "image_spread": np.zeros((I,), np.float32),
+            "pod_kv": np.zeros((PP, L), bool),
+            "pod_key": np.zeros((PP, K), bool),
+            "pod_ns_hot": np.zeros((PP, NS), np.float32),
+            "pod_node": np.full((PP,), -1, np.int32),
+            "pod_valid": np.zeros((PP,), bool),
+        }
+
+        # vocab metadata
+        for i in range(len(t.taint)):
+            _, _, effect = t.taint.key(i)
+            d["taint_is_hard"][i] = effect in (api.TAINT_EFFECT_NO_SCHEDULE,
+                                               api.TAINT_EFFECT_NO_EXECUTE)
+            d["taint_is_prefer"][i] = effect == api.TAINT_EFFECT_PREFER_NO_SCHEDULE
+
+        image_nodes = np.zeros((I,), np.float32)
+        pod_row = 0
+        pod_rows: Dict[str, int] = {}  # pod uid -> row
+        filter_owners: List[Tuple[PodInfo, int]] = []
+        score_owners: List[Tuple[PodInfo, int]] = []
+
+        for n_idx, ni in enumerate(nodes):
+            node = ni.node
+            if node is None:
+                continue
+            d["node_valid"][n_idx] = True
+            d["unschedulable"][n_idx] = node.spec.unschedulable
+            d["allocatable"][n_idx] = resource_to_channels(ni.allocatable, t, R)
+            req = resource_to_channels(ni.requested, t, R)
+            req[CH_PODS] = len(ni.pods)
+            d["requested"][n_idx] = req
+            d["nonzero_requested"][n_idx, 0] = ni.non_zero_requested.milli_cpu
+            d["nonzero_requested"][n_idx, 1] = ni.non_zero_requested.memory / MIB
+            labels = dict(node.metadata.labels)
+            labels[FIELD_PREFIX + "metadata.name"] = node.name
+            for k, v in labels.items():
+                d["kv"][n_idx, t.kv.get((k, v))] = True
+                ki = t.key.get(k)
+                d["keymask"][n_idx, ki] = True
+                try:
+                    d["num"][n_idx, ki] = float(int(v))
+                except ValueError:
+                    pass
+            for tk_i in range(len(t.topokey)):
+                tk = t.topokey.key(tk_i)
+                if tk in labels:
+                    d["topo_pair"][n_idx, tk_i] = t.kv.get((tk, labels[tk]))
+            for taint in node.spec.taints:
+                d["taints"][n_idx, t.taint.get((taint.key, taint.value, taint.effect))] = True
+            for triple in ni.used_ports:
+                for pid in _port_ids_node(triple):
+                    d["ports"][n_idx, t.port.get(pid)] = True
+            for name, size in ni.image_states.items():
+                ii = t.image.get(_norm_image(name))
+                d["images"][n_idx, ii] = True
+                d["image_size"][ii] = size
+            for ii in np.nonzero(d["images"][n_idx])[0]:
+                image_nodes[ii] += 1
+            d["avoid_pods"][n_idx] = _avoid_pods_flags(node)
+
+            for pi in ni.pods:
+                p = pi.pod
+                d["pod_node"][pod_row] = n_idx
+                d["pod_valid"][pod_row] = True
+                d["pod_ns_hot"][pod_row, t.ns.get(p.namespace)] = 1.0
+                for k, v in p.metadata.labels.items():
+                    d["pod_kv"][pod_row, t.kv.get((k, v))] = True
+                    d["pod_key"][pod_row, t.key.get(k)] = True
+                pod_rows[p.uid] = pod_row
+                if pi.required_anti_affinity_terms:
+                    filter_owners.append((pi, pod_row))
+                if (pi.preferred_affinity_terms or pi.preferred_anti_affinity_terms
+                        or pi.required_affinity_terms):
+                    score_owners.append((pi, pod_row))
+                pod_row += 1
+
+        n_valid = max(float(len(nodes)), 1.0)
+        d["image_spread"] = image_nodes / n_valid
+
+        d["filter_terms"] = self._build_terms(filter_owners, kind="filter")
+        d["score_terms"] = self._build_terms(score_owners, kind="score")
+        return HostClusterArrays(arrays=d)
+
+    def _build_terms(self, owners: List[Tuple[PodInfo, int]], kind: str) -> ExistingTerms:
+        t = self.table
+        NS = t.ns.cap
+        sels, nss, topos, pods, weights = [], [], [], [], []
+
+        def add(term, pod_row, weight):
+            sels.append(term.selector)
+            nss.append(term.namespaces)
+            topos.append(t.topokey.get(term.topology_key))
+            pods.append(pod_row)
+            weights.append(float(weight))
+
+        for pi, row in owners:
+            if kind == "filter":
+                for term in pi.required_anti_affinity_terms:
+                    add(term, row, 1.0)
+            else:
+                for w in pi.preferred_affinity_terms:
+                    add(w.term, row, w.weight)
+                for w in pi.preferred_anti_affinity_terms:
+                    add(w.term, row, -w.weight)
+                if self.hard_pod_affinity_weight:
+                    for term in pi.required_affinity_terms:
+                        add(term, row, self.hard_pod_affinity_weight)
+
+        Et = pow2_bucket(len(sels), 1)
+        sel_set = self.compiler.compile(sels + [None] * (Et - len(sels)), pad_s=Et)
+        ns_hot = np.zeros((Et, NS), np.float32)
+        topo_key = np.zeros((Et,), np.int32)
+        pod_idx = np.zeros((Et,), np.int32)
+        weight = np.zeros((Et,), np.float32)
+        valid = np.zeros((Et,), bool)
+        for i in range(len(sels)):
+            for ns in nss[i]:
+                j = t.ns.get(ns)
+                if j >= 0:
+                    ns_hot[i, j] = 1.0
+            topo_key[i] = max(topos[i], 0)
+            pod_idx[i] = pods[i]
+            weight[i] = weights[i]
+            valid[i] = True
+        return ExistingTerms(sel=sel_set, ns_hot=ns_hot, topo_key=topo_key,
+                             pod_idx=pod_idx, weight=weight, valid=valid)
+
+
+def _norm_image(name: str) -> str:
+    """Normalize image name: bare names get :latest; a registry-less repo is
+    left as-is (reference: imagelocality/image_locality.go normalizedImageName)."""
+    if "@" in name:
+        return name
+    tag_sep = name.rfind(":")
+    slash = name.rfind("/")
+    if tag_sep <= slash:  # no tag after last path component
+        return name + ":latest"
+    return name
+
+
+WILDCARD_IP = "0.0.0.0"
+_ANY = "__any__"
+_WILD = "__wild__"
+
+
+def _port_ids_node(triple: Tuple[str, str, int]):
+    """Port ids a *node* registers for one used (proto, ip, port).
+
+    Encodes HostPortInfo's wildcard semantics
+    (reference: framework/v1alpha1/types.go:694 HostPortInfo.CheckConflict)
+    as set-intersection: specific ip registers {specific, ANY}; wildcard
+    registers {WILD, ANY}.  A pod checks {specific, WILD} (specific ip) or
+    {ANY} (wildcard).  Intersection != 0  <=>  CheckConflict == true.
+    """
+    proto, ip, port = triple
+    if ip == WILDCARD_IP:
+        return [(proto, _WILD, port), (proto, _ANY, port)]
+    return [(proto, ip, port), (proto, _ANY, port)]
+
+
+def port_ids_pod(triple: Tuple[str, str, int]):
+    """Port ids a *pod* probes for one wanted (proto, ip, port)."""
+    proto, ip, port = triple
+    if ip == WILDCARD_IP:
+        return [(proto, _ANY, port)]
+    return [(proto, ip, port), (proto, _WILD, port)]
+
+
+def _avoid_pods_flags(node: api.Node) -> np.ndarray:
+    """[has RC avoid entry, has RS avoid entry] from the preferAvoidPods
+    annotation (reference: nodepreferavoidpods/node_prefer_avoid_pods.go:60)."""
+    out = np.zeros((2,), bool)
+    raw = node.metadata.annotations.get(api.PREFER_AVOID_PODS_ANNOTATION_KEY)
+    if not raw:
+        return out
+    import json
+    try:
+        doc = json.loads(raw)
+        for entry in doc.get("preferAvoidPods", []):
+            kind = entry.get("podSignature", {}).get("podController", {}).get("kind", "")
+            if kind == "ReplicationController":
+                out[0] = True
+            elif kind == "ReplicaSet":
+                out[1] = True
+    except (ValueError, AttributeError):
+        pass
+    return out

@@ -1,0 +1,214 @@
+"""Tensorization and selector-matching unit tests (golden semantics from
+apimachinery labels.Selector and scheduler NodeInfo behavior)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubetpu.api import types as api
+from kubetpu.api.resource import Resource, parse_quantity, to_milli
+from kubetpu.framework.types import NodeInfo, PodInfo, compute_pod_resource_request
+from kubetpu.models.batch import PodBatchBuilder
+from kubetpu.ops.selectors import SelectorCompiler, match_selectors
+from kubetpu.state.tensors import CH_CPU, CH_MEM, CH_PODS, SnapshotBuilder
+from kubetpu.utils.intern import InternTable
+
+
+def mkpod(name="p", ns="default", labels=None, cpu="100m", mem="200Mi",
+          node_name="", priority=None, **spec_kw):
+    containers = [api.Container(name="c", image="img:1", resources=api.ResourceRequirements(
+        requests={"cpu": cpu, "memory": mem} if cpu else {}))]
+    return api.Pod(metadata=api.ObjectMeta(name=name, namespace=ns, labels=labels or {}),
+                   spec=api.PodSpec(containers=containers, node_name=node_name,
+                                    priority=priority, **spec_kw))
+
+
+def mknode(name="n", labels=None, cpu="4", mem="32Gi", pods="110", taints=None,
+           unschedulable=False):
+    return api.Node(
+        metadata=api.ObjectMeta(name=name, labels=labels or {}),
+        spec=api.NodeSpec(taints=taints or [], unschedulable=unschedulable),
+        status=api.NodeStatus(allocatable={"cpu": cpu, "memory": mem, "pods": pods}))
+
+
+class TestQuantity:
+    def test_parse(self):
+        assert parse_quantity("100m") == pytest.approx(0.1)
+        assert parse_quantity("4") == 4
+        assert parse_quantity("32Gi") == 32 * 2**30
+        assert parse_quantity("500M") == 500e6
+        assert to_milli("250m") == 250
+        assert to_milli("2") == 2000
+
+    def test_pod_request_max_init(self):
+        # requests = max(sum(containers), each init container) + overhead
+        # (reference: noderesources/fit.go:112-129)
+        pod = api.Pod(spec=api.PodSpec(
+            containers=[
+                api.Container(resources=api.ResourceRequirements(requests={"cpu": "1", "memory": "1Gi"})),
+                api.Container(resources=api.ResourceRequirements(requests={"cpu": "2", "memory": "1Gi"})),
+            ],
+            init_containers=[
+                api.Container(resources=api.ResourceRequirements(requests={"cpu": "4", "memory": "1Gi"})),
+            ],
+            overhead={"cpu": "500m"}))
+        r = compute_pod_resource_request(pod)
+        assert r.milli_cpu == 4000 + 500
+        assert r.memory == 2 * 2**30
+
+
+class TestSelectors:
+    def _match(self, selectors, label_maps):
+        table = InternTable()
+        for lm in label_maps:
+            table.intern_labels(lm)
+        comp = SelectorCompiler(table)
+        sel = comp.compile(selectors)
+        L, K = table.kv.cap, table.key.cap
+        M = len(label_maps)
+        kv = np.zeros((M, L), bool)
+        key = np.zeros((M, K), bool)
+        num = np.full((M, K), np.nan, np.float32)
+        for i, lm in enumerate(label_maps):
+            for k, v in lm.items():
+                kv[i, table.kv.get((k, v))] = True
+                key[i, table.key.get(k)] = True
+                try:
+                    num[i, table.key.get(k)] = float(int(v))
+                except ValueError:
+                    pass
+        out = match_selectors(sel, jnp.asarray(kv), jnp.asarray(key), jnp.asarray(num))
+        return np.asarray(out)[:len(selectors)]
+
+    def test_match_labels(self):
+        got = self._match([{"a": "1"}], [{"a": "1"}, {"a": "2"}, {}])
+        np.testing.assert_array_equal(got[0], [True, False, False])
+
+    def test_ops(self):
+        sel = api.LabelSelector(match_expressions=[
+            api.LabelSelectorRequirement("env", "In", ["prod", "canary"])])
+        got = self._match([sel], [{"env": "prod"}, {"env": "dev"}, {}])
+        np.testing.assert_array_equal(got[0], [True, False, False])
+
+        sel = api.LabelSelector(match_expressions=[
+            api.LabelSelectorRequirement("env", "NotIn", ["prod"])])
+        got = self._match([sel], [{"env": "prod"}, {"env": "dev"}, {}])
+        np.testing.assert_array_equal(got[0], [False, True, True])
+
+        sel = api.LabelSelector(match_expressions=[
+            api.LabelSelectorRequirement("env", "Exists")])
+        got = self._match([sel], [{"env": "prod"}, {"x": "1"}])
+        np.testing.assert_array_equal(got[0], [True, False])
+
+        sel = api.LabelSelector(match_expressions=[
+            api.LabelSelectorRequirement("env", "DoesNotExist")])
+        got = self._match([sel], [{"env": "prod"}, {"x": "1"}])
+        np.testing.assert_array_equal(got[0], [False, True])
+
+    def test_gt_lt(self):
+        term = api.NodeSelectorTerm(match_expressions=[
+            api.NodeSelectorRequirement("cores", "Gt", ["8"])])
+        got = self._match([term], [{"cores": "16"}, {"cores": "4"}, {"cores": "abc"}, {}])
+        np.testing.assert_array_equal(got[0], [True, False, False, False])
+
+    def test_and_of_requirements(self):
+        sel = api.LabelSelector(match_labels={"a": "1"}, match_expressions=[
+            api.LabelSelectorRequirement("b", "Exists")])
+        got = self._match([sel], [{"a": "1", "b": "x"}, {"a": "1"}, {"b": "x"}])
+        np.testing.assert_array_equal(got[0], [True, False, False])
+
+    def test_nil_vs_empty(self):
+        # nil selector matches nothing; empty selector matches everything
+        got = self._match([None, api.LabelSelector()], [{"a": "1"}, {}])
+        np.testing.assert_array_equal(got[0], [False, False])
+        np.testing.assert_array_equal(got[1], [True, True])
+
+    def test_host_matches_agree(self):
+        sel = api.LabelSelector(match_expressions=[
+            api.LabelSelectorRequirement("env", "NotIn", ["prod"]),
+            api.LabelSelectorRequirement("tier", "Exists")])
+        maps = [{"env": "dev", "tier": "web"}, {"env": "prod", "tier": "web"},
+                {"tier": "db"}, {}]
+        got = self._match([sel], maps)
+        want = [sel.matches(m) for m in maps]
+        np.testing.assert_array_equal(got[0], want)
+
+
+class TestSnapshot:
+    def test_node_channels(self):
+        ni = NodeInfo(mknode("n1", cpu="4", mem="32Gi", pods="110"))
+        ni.add_pod(mkpod("p1", cpu="1", mem="1Gi"))
+        sb = SnapshotBuilder()
+        host = sb.build([ni])
+        d = host.arrays
+        assert d["node_valid"][0] and not d["node_valid"][1]
+        assert d["allocatable"][0, CH_CPU] == 4000
+        assert d["allocatable"][0, CH_MEM] == 32 * 1024
+        assert d["allocatable"][0, CH_PODS] == 110
+        assert d["requested"][0, CH_CPU] == 1000
+        assert d["requested"][0, CH_MEM] == 1024
+        assert d["requested"][0, CH_PODS] == 1
+
+    def test_nonzero_defaults(self):
+        # zero-request pods count as 100m CPU / 200MB memory
+        # (reference: pkg/scheduler/util/non_zero.go:30-48)
+        ni = NodeInfo(mknode("n1"))
+        ni.add_pod(mkpod("p1", cpu=None))
+        sb = SnapshotBuilder()
+        d = sb.build([ni]).arrays
+        assert d["nonzero_requested"][0, 0] == 100
+        assert d["nonzero_requested"][0, 1] == pytest.approx(200.0)
+
+    def test_pod_rows_and_terms(self):
+        anti = api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
+            required_during_scheduling_ignored_during_execution=[
+                api.PodAffinityTerm(
+                    label_selector=api.LabelSelector(match_labels={"app": "web"}),
+                    topology_key="topology.kubernetes.io/zone")]))
+        ni = NodeInfo(mknode("n1", labels={"topology.kubernetes.io/zone": "z1"}))
+        ni.add_pod(mkpod("p1", labels={"app": "web"}, affinity=anti))
+        sb = SnapshotBuilder()
+        d = sb.build([ni]).arrays
+        assert d["pod_valid"][0]
+        assert d["pod_node"][0] == 0
+        ft = d["filter_terms"]
+        assert ft.valid[0]
+        # zone topo pair resolved on node row
+        tk = sb.table.topokey.get("topology.kubernetes.io/zone")
+        assert d["topo_pair"][0, tk] == sb.table.kv.get(
+            ("topology.kubernetes.io/zone", "z1"))
+
+    def test_to_device(self):
+        ni = NodeInfo(mknode("n1"))
+        ct = SnapshotBuilder().build([ni]).to_device()
+        assert ct.allocatable.shape[0] == 8
+        assert bool(ct.node_valid[0])
+
+
+class TestPodBatch:
+    def test_basic(self):
+        ni = NodeInfo(mknode("n1", labels={"zone": "a"}))
+        sb = SnapshotBuilder()
+        sb.build([ni])
+        pb = PodBatchBuilder(sb.table)
+        pods = [PodInfo(mkpod("p1", cpu="500m", mem="1Gi", priority=10,
+                              node_name="n1"))]
+        batch = pb.build(pods)
+        assert batch.valid[0] and not batch.valid[1]
+        assert batch.req[0, CH_CPU] == 500
+        assert batch.priority[0] == 10
+        assert batch.has_node_name[0]
+        assert batch.node_name_kvid[0] >= 0
+
+    def test_tolerations(self):
+        taint = api.Taint(key="k", value="v", effect="NoSchedule")
+        ni = NodeInfo(mknode("n1", taints=[taint]))
+        sb = SnapshotBuilder()
+        sb.build([ni])
+        pb = PodBatchBuilder(sb.table)
+        tol = api.Toleration(key="k", operator="Equal", value="v", effect="NoSchedule")
+        batch = pb.build([PodInfo(mkpod("p1", tolerations=[tol])),
+                          PodInfo(mkpod("p2"))])
+        ti = sb.table.taint.get(("k", "v", "NoSchedule"))
+        assert batch.tolerated[0, ti]
+        assert not batch.tolerated[1, ti]
