@@ -59,15 +59,31 @@ def compute_pod_resource_limits(pod: api.Pod) -> Resource:
     return r
 
 
-def non_zero_request(req: Resource) -> Tuple[int, int]:
-    """(milli_cpu, memory) with zero requests defaulted to 100m / 200MB.
+def non_zero_request(pod: api.Pod) -> Tuple[int, int]:
+    """(milli_cpu, memory) where each *container* with a zero request is
+    defaulted to 100m / 200MB, aggregated with the same
+    max(sum(containers), init) + overhead rule.
 
     reference: pkg/scheduler/util/non_zero.go:30-48
-    (GetNonzeroRequestForResource), used by BalancedAllocation via
-    NodeInfo.NonZeroRequested.
+    (GetNonzeroRequestForResource, applied per container in
+    types.go:432 calculateResource and
+    noderesources/resource_allocation.go:118 calculatePodResourceRequest).
     """
-    cpu = req.milli_cpu if req.milli_cpu != 0 else DEFAULT_MILLI_CPU_REQUEST
-    mem = req.memory if req.memory != 0 else DEFAULT_MEMORY_REQUEST
+    from ..api.resource import to_int, to_milli
+    cpu = mem = 0
+    for c in pod.spec.containers:
+        ccpu = to_milli(c.resources.requests.get("cpu", 0))
+        cmem = to_int(c.resources.requests.get("memory", 0))
+        cpu += ccpu if ccpu != 0 else DEFAULT_MILLI_CPU_REQUEST
+        mem += cmem if cmem != 0 else DEFAULT_MEMORY_REQUEST
+    for ic in pod.spec.init_containers:
+        ccpu = to_milli(ic.resources.requests.get("cpu", 0))
+        cmem = to_int(ic.resources.requests.get("memory", 0))
+        cpu = max(cpu, ccpu if ccpu != 0 else DEFAULT_MILLI_CPU_REQUEST)
+        mem = max(mem, cmem if cmem != 0 else DEFAULT_MEMORY_REQUEST)
+    if pod.spec.overhead:
+        cpu += to_milli(pod.spec.overhead.get("cpu", 0))
+        mem += to_int(pod.spec.overhead.get("memory", 0))
     return cpu, mem
 
 
@@ -141,7 +157,7 @@ class PodInfo:
                 self.preferred_anti_affinity_terms = _get_weighted_terms(
                     pod, aff.pod_anti_affinity.preferred_during_scheduling_ignored_during_execution)
         self.resource = compute_pod_resource_request(pod)
-        self.non_zero_cpu, self.non_zero_mem = non_zero_request(self.resource)
+        self.non_zero_cpu, self.non_zero_mem = non_zero_request(pod)
 
 
 @dataclass

@@ -62,7 +62,11 @@ class PodBatch(NamedTuple):
     tolerated: np.ndarray      # [B, T] bool over taint vocab
     priority: np.ndarray       # [B] i32
     images_hot: np.ndarray     # [B, I] f32 — container images (non-init)
-    controller_kind: np.ndarray  # [B, 2] bool (owned by RC, RS) for NodePreferAvoidPods
+    n_containers: np.ndarray   # [B] f32 — len(spec.containers) for ImageLocality
+    avoid_id: np.ndarray       # [B] i32 — (controllerRef kind, uid) vocab id, -1 if
+                               #   not controlled by an RC/RS (NodePreferAvoidPods)
+    tolerates_unschedulable: np.ndarray  # [B] bool — tolerates the
+                               #   node.kubernetes.io/unschedulable:NoSchedule taint
     node_selector: SelectorSet  # [B] spec.nodeSelector as a selector
     rna_sel: SelectorSet       # [B*Tn] required node affinity terms (ORed)
     rna_valid: np.ndarray      # [B, Tn]
@@ -75,6 +79,10 @@ class PodBatch(NamedTuple):
     pref: PodTerms             # preferred affinity and anti (signed weights)
     spread: SpreadConstraints  # hard (DoNotSchedule) constraints
     spread_soft: SpreadConstraints  # soft (ScheduleAnyway) constraints
+    spread_selector: SelectorSet  # [B] DefaultPodTopologySpread selector (the
+                               # combined service/RC/RS/SS selector; nil => score 0)
+    spread_skip: np.ndarray    # [B] bool — pod has explicit spread constraints, so
+                               # DefaultPodTopologySpread is skipped entirely
     valid: np.ndarray          # [B] bool padding mask
 
     @property
@@ -87,7 +95,11 @@ class PodBatchBuilder:
         self.table = table
         self.compiler = SelectorCompiler(table)
 
-    def build(self, pods: Sequence[PodInfo], pad_b: Optional[int] = None) -> PodBatch:
+    def build(self, pods: Sequence[PodInfo], pad_b: Optional[int] = None,
+              spread_selectors: Optional[Sequence] = None) -> PodBatch:
+        """spread_selectors: per-pod combined service/RC/RS/SS selector for
+        DefaultPodTopologySpread (reference: plugins/helper/spread.go
+        DefaultSelector), or None per pod when nothing selects it."""
         t = self.table
         B = pad_b if pad_b is not None else pow2_bucket(len(pods), 8)
         if B < len(pods):
@@ -108,7 +120,9 @@ class PodBatchBuilder:
         tolerated = np.zeros((B, T), bool)
         priority = np.zeros((B,), np.int32)
         images_hot = np.zeros((B, I), np.float32)
-        controller_kind = np.zeros((B, 2), bool)
+        n_containers = np.zeros((B,), np.float32)
+        avoid_id = np.full((B,), -1, np.int32)
+        tolerates_unschedulable = np.zeros((B,), bool)
         valid = np.zeros((B,), bool)
 
         node_selectors: List = []
@@ -158,11 +172,18 @@ class PodBatchBuilder:
                 tolerated[i, ti] = api.tolerations_tolerate_taint(
                     p.spec.tolerations, taint)
             priority[i] = p.priority()
+            n_containers[i] = len(p.spec.containers)
+            # reference: nodepreferavoidpods/node_prefer_avoid_pods.go:57 —
+            # only RC/RS controllers participate; others score MaxNodeScore.
             for ref in p.metadata.owner_references:
-                if ref.controller and ref.kind == "ReplicationController":
-                    controller_kind[i, 0] = True
-                elif ref.controller and ref.kind == "ReplicaSet":
-                    controller_kind[i, 1] = True
+                if ref.controller and ref.kind in ("ReplicationController", "ReplicaSet"):
+                    avoid_id[i] = t.avoid.get((ref.kind, ref.uid))
+                    break
+            # reference: nodeunschedulable/node_unschedulable.go:56
+            tolerates_unschedulable[i] = api.tolerations_tolerate_taint(
+                p.spec.tolerations,
+                api.Taint(key="node.kubernetes.io/unschedulable",
+                          effect=api.TAINT_EFFECT_NO_SCHEDULE))
 
             node_selectors.append(dict(p.spec.node_selector)
                                   if p.spec.node_selector else {})
@@ -203,13 +224,29 @@ class PodBatchBuilder:
         for i in range(B):
             terms = pna_terms[i] if i < len(pods) else []
             for j in range(Tp):
-                if j < len(terms):
-                    pna_flat.append(terms[j].preference)
+                if j < len(terms) and terms[j].weight != 0:
+                    # Preferred terms use only matchExpressions, and an empty
+                    # preference matches every node (reference:
+                    # nodeaffinity/node_affinity.go:81-99).
+                    exprs = terms[j].preference.match_expressions
+                    if exprs:
+                        pna_flat.append(api.NodeSelectorTerm(match_expressions=exprs))
+                    else:
+                        pna_flat.append(api.LabelSelector())
                     pna_weight[i, j] = terms[j].weight
                     pna_valid[i, j] = True
                 else:
                     pna_flat.append(None)
         pna_sel = self.compiler.compile(pna_flat, pad_s=B * Tp, intern_new=False)
+
+        if spread_selectors is None:
+            spread_selectors = [None] * len(pods)
+        spread_sel_list = list(spread_selectors) + [None] * (B - len(pods))
+        spread_selector = self.compiler.compile(spread_sel_list, pad_s=B,
+                                                intern_new=False)
+        spread_skip = np.zeros((B,), bool)
+        for i, pi in enumerate(pods):
+            spread_skip[i] = bool(pi.pod.spec.topology_spread_constraints)
 
         ra = self._build_pod_terms(pods, B, "required_affinity")
         raa = self._build_pod_terms(pods, B, "required_anti")
@@ -221,11 +258,14 @@ class PodBatchBuilder:
                         key_hot=key_hot, ns_hot=ns_hot, node_name_kvid=node_name_kvid,
                         has_node_name=has_node_name, ports_hot=ports_hot,
                         tolerated=tolerated, priority=priority, images_hot=images_hot,
-                        controller_kind=controller_kind, node_selector=node_selector,
+                        n_containers=n_containers, avoid_id=avoid_id,
+                        tolerates_unschedulable=tolerates_unschedulable,
+                        node_selector=node_selector,
                         rna_sel=rna_sel, rna_valid=rna_valid, has_rna=has_rna,
                         pna_sel=pna_sel, pna_weight=pna_weight, pna_valid=pna_valid,
                         ra=ra, raa=raa, pref=pref, spread=spread_hard,
-                        spread_soft=spread_soft, valid=valid)
+                        spread_soft=spread_soft, spread_selector=spread_selector,
+                        spread_skip=spread_skip, valid=valid)
 
     def _term_lists(self, pi: PodInfo, kind: str):
         if kind == "required_affinity":

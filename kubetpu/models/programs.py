@@ -1,0 +1,172 @@
+"""Composed scheduling programs: the device-side replacement for the
+reference's per-pod Filter -> Score -> NormalizeScore -> weight -> selectHost
+pipeline (reference: pkg/scheduler/core/generic_scheduler.go:146 Schedule,
+prioritizeNodes :622, selectHost :217; weight application
+framework/v1alpha1/framework.go:579-656).
+
+A ScheduleProgram is configured with a static plugin set + weights (one per
+scheduler profile) and jit-compiles one XLA program that filters and scores a
+whole batch of B pods against N nodes at once.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import kernels as K
+
+# Default plugin weights (reference: algorithmprovider/registry.go:119-134).
+DEFAULT_SCORE_PLUGINS: Tuple[Tuple[str, int], ...] = (
+    ("NodeResourcesBalancedAllocation", 1),
+    ("ImageLocality", 1),
+    ("InterPodAffinity", 1),
+    ("NodeResourcesLeastAllocated", 1),
+    ("NodeAffinity", 1),
+    ("NodePreferAvoidPods", 10000),
+    ("PodTopologySpread", 2),
+    ("DefaultPodTopologySpread", 1),
+    ("TaintToleration", 1),
+)
+
+DEFAULT_FILTER_PLUGINS: Tuple[str, ...] = (
+    "NodeUnschedulable",
+    "NodeResourcesFit",
+    "NodeName",
+    "NodePorts",
+    "NodeAffinity",
+    "TaintToleration",
+    "PodTopologySpread",
+    "InterPodAffinity",
+)
+
+# Filters whose failure is UnschedulableAndUnresolvable — preemption cannot
+# help on such nodes (reference: status codes per plugin; consumed by
+# nodesWherePreemptionMightHelp, core/generic_scheduler.go:1041).
+UNRESOLVABLE_FILTERS = frozenset({
+    "NodeUnschedulable", "NodeName", "NodeAffinity", "TaintToleration",
+})
+
+
+class ProgramConfig(NamedTuple):
+    """Static (hashable) program configuration — one per profile."""
+    filters: Tuple[str, ...] = DEFAULT_FILTER_PLUGINS
+    scores: Tuple[Tuple[str, int], ...] = DEFAULT_SCORE_PLUGINS
+    hostname_topokey: int = 0  # topokey vocab id of kubernetes.io/hostname
+
+
+class FilterScoreResult(NamedTuple):
+    feasible: jnp.ndarray       # [B, N] bool
+    unresolvable: jnp.ndarray   # [B, N] bool (failed beyond preemption help)
+    scores: jnp.ndarray         # [B, N] f32 weighted total (0 where infeasible)
+    plugin_scores: Dict[str, jnp.ndarray]  # per-plugin weighted [B, N]
+
+
+def run_filters(cluster, batch, cfg: ProgramConfig):
+    """Returns (feasible, unresolvable, node_affinity_ok)."""
+    base = cluster.node_valid[None, :] & batch.valid[:, None]
+    feasible = base
+    unresolvable = jnp.zeros_like(base)
+    affinity_ok = K.node_affinity_filter(cluster, batch)
+
+    for name in cfg.filters:
+        if name == "NodeUnschedulable":
+            ok = K.node_unschedulable_filter(cluster, batch)
+        elif name == "NodeResourcesFit":
+            ok = K.fit_filter(cluster, batch)
+        elif name == "NodeName":
+            ok = K.node_name_filter(cluster, batch)
+        elif name == "NodePorts":
+            ok = K.node_ports_filter(cluster, batch)
+        elif name == "NodeAffinity":
+            ok = affinity_ok
+        elif name == "TaintToleration":
+            ok = K.taint_filter(cluster, batch)
+        elif name == "PodTopologySpread":
+            ok = K.spread_filter(cluster, batch, affinity_ok)
+        elif name == "InterPodAffinity":
+            ok, aff_unres = K.interpod_filter(cluster, batch)
+            unresolvable = unresolvable | (aff_unres & base)
+        else:
+            raise ValueError(f"unknown filter kernel {name}")
+        if name in UNRESOLVABLE_FILTERS:
+            unresolvable = unresolvable | (~ok & base)
+        feasible = feasible & ok
+    return feasible, unresolvable, affinity_ok
+
+
+def run_scores(cluster, batch, cfg: ProgramConfig, feasible, affinity_ok):
+    """Per-plugin normalized scores x weight, summed
+    (reference: framework.go:579-656 RunScorePlugins)."""
+    total = jnp.zeros(feasible.shape, jnp.float32)
+    per_plugin: Dict[str, jnp.ndarray] = {}
+    for name, weight in cfg.scores:
+        if name == "NodeResourcesBalancedAllocation":
+            s = K.balanced_allocation_score(cluster, batch)
+        elif name == "ImageLocality":
+            s = K.image_locality_score(cluster, batch)
+        elif name == "InterPodAffinity":
+            s = K.interpod_score(cluster, batch, feasible)
+        elif name == "NodeResourcesLeastAllocated":
+            s = K.least_allocated_score(cluster, batch)
+        elif name == "NodeResourcesMostAllocated":
+            s = K.most_allocated_score(cluster, batch)
+        elif name == "NodeAffinity":
+            s = K.default_normalize(K.node_affinity_score(cluster, batch),
+                                    feasible, reverse=False)
+        elif name == "NodePreferAvoidPods":
+            s = K.prefer_avoid_pods_score(cluster, batch)
+        elif name == "PodTopologySpread":
+            s = K.spread_soft_score(cluster, batch, feasible, affinity_ok,
+                                    cfg.hostname_topokey)
+        elif name == "DefaultPodTopologySpread":
+            raw = K.default_spread_score(cluster, batch)
+            s = K.default_spread_normalize(cluster, batch, raw, feasible)
+        elif name == "TaintToleration":
+            s = K.default_normalize(K.taint_toleration_score(cluster, batch),
+                                    feasible, reverse=True)
+        else:
+            raise ValueError(f"unknown score kernel {name}")
+        s = jnp.where(feasible, s, 0.0) * float(weight)
+        per_plugin[name] = s
+        total = total + s
+    return total, per_plugin
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def filter_and_score(cluster, batch, cfg: ProgramConfig) -> FilterScoreResult:
+    feasible, unresolvable, affinity_ok = run_filters(cluster, batch, cfg)
+    scores, per_plugin = run_scores(cluster, batch, cfg, feasible, affinity_ok)
+    return FilterScoreResult(feasible=feasible, unresolvable=unresolvable,
+                             scores=scores, plugin_scores=per_plugin)
+
+
+def select_host(scores: jnp.ndarray, feasible: jnp.ndarray,
+                rng: jnp.ndarray) -> jnp.ndarray:
+    """Masked argmax with uniform tie-break among max-score nodes
+    (reference: generic_scheduler.go:217 selectHost — reservoir sampling;
+    here a seeded categorical over the tie set, equivalent in distribution).
+    Returns [B] node index, -1 when no feasible node."""
+    B = scores.shape[0]
+    neg = jnp.float32(-2**62)
+    masked = jnp.where(feasible, scores, neg)
+    best = jnp.max(masked, axis=1, keepdims=True)
+    ties = (masked == best) & feasible
+    logits = jnp.where(ties, 0.0, neg)
+    keys = jax.random.split(rng, B)
+    choice = jax.vmap(lambda k, lg: jax.random.categorical(k, lg))(keys, logits)
+    has = jnp.any(feasible, axis=1)
+    return jnp.where(has, choice.astype(jnp.int32), -1)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def schedule_batch(cluster, batch, cfg: ProgramConfig, rng):
+    """One-shot independent scheduling of a batch: every pod scored against
+    the same snapshot (no intra-batch interactions).  Used for gang/auction
+    modes and as the building block of the sequential scan program."""
+    res = filter_and_score(cluster, batch, cfg)
+    chosen = select_host(res.scores, res.feasible, rng)
+    return res, chosen

@@ -1,0 +1,574 @@
+"""Batched Filter/Score kernels: the TPU-native re-implementation of every
+default-enabled scheduler plugin's algorithm (reference:
+pkg/scheduler/framework/plugins/*, default matrix in
+pkg/scheduler/algorithmprovider/registry.go:77-160).
+
+Shape conventions: B pending pods x N nodes x P existing pods.  All kernels
+are pure jnp functions over (ClusterTensors, PodBatch) pytrees, composed and
+jitted by kubetpu/models/programs.py.  Where the reference runs int64
+arithmetic, we use f32 with explicit floor() at every integer-division /
+truncation site so scores agree exactly for in-range values (see
+state/tensors.py for the unit-scaling argument).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..state.tensors import CH_CPU, CH_EPH, CH_MEM, CH_PODS, N_FIXED_CHANNELS
+from .selectors import match_selectors
+
+MAX_NODE_SCORE = 100.0  # reference: framework/v1alpha1/interface.go:85
+
+
+def _f(x):
+    return x.astype(jnp.float32)
+
+
+def _idiv(a, b):
+    """Go int64 division (truncation toward zero) for non-negative operands;
+    b == 0 guarded by callers."""
+    return jnp.floor(a / b)
+
+
+# ---------------------------------------------------------------------------
+# shared aggregation helpers
+
+
+def per_node_counts(match_sp: jnp.ndarray, pod_node: jnp.ndarray, n_nodes: int) -> jnp.ndarray:
+    """[S, P] per-existing-pod values -> [S, N] per-node sums."""
+    data = _f(match_sp).T  # [P, S]
+    seg = jax.ops.segment_sum(data, jnp.clip(pod_node, 0, n_nodes - 1),
+                              num_segments=n_nodes,
+                              indices_are_sorted=False)
+    return seg.T
+
+
+def pair_scatter(values_sn: jnp.ndarray, pair_sn: jnp.ndarray, L: int) -> jnp.ndarray:
+    """Aggregate per-(s, item) values by topology-pair id -> [S, L].
+    pair id -1 entries are dropped."""
+    ids = jnp.where(pair_sn >= 0, pair_sn, L)
+    out = jax.vmap(lambda v, i: jax.ops.segment_sum(v, i, num_segments=L + 1))(
+        _f(values_sn), ids)
+    return out[:, :L]
+
+
+def pair_gather(pair_counts_sl: jnp.ndarray, pair_sn: jnp.ndarray) -> jnp.ndarray:
+    """[S, L] pair values gathered back to items via [S, N] pair ids; -1 -> 0."""
+    got = jnp.take_along_axis(pair_counts_sl, jnp.clip(pair_sn, 0, None), axis=1)
+    return jnp.where(pair_sn >= 0, got, 0.0)
+
+
+def node_topo_pairs(cluster, topo_key_sb: jnp.ndarray) -> jnp.ndarray:
+    """For selector rows with topology-key ids [S] (or [S, ...] flattened),
+    return each node's pair id [S, N] (-1 if the node lacks the key)."""
+    return jnp.take(cluster.topo_pair.T, topo_key_sb, axis=0)  # [S, N]
+
+
+def pod_topo_pairs(cluster, topo_key_s: jnp.ndarray) -> jnp.ndarray:
+    """Pair ids of each *existing pod's node* for given keys -> [S, P]."""
+    pod_topo = jnp.take(cluster.topo_pair, jnp.clip(cluster.pod_node, 0, None),
+                        axis=0)  # [P, TK]
+    pairs = jnp.take(pod_topo.T, topo_key_s, axis=0)  # [S, P]
+    return jnp.where((cluster.pod_node >= 0) & cluster.pod_valid, pairs, -1)
+
+
+# ---------------------------------------------------------------------------
+# filters — each returns ok [B, N] bool (over valid nodes; caller masks padding)
+
+
+def fit_filter(cluster, batch, ignored_channels: jnp.ndarray | None = None) -> jnp.ndarray:
+    """NodeResourcesFit (reference: noderesources/fit.go:194-267 fitsRequest).
+    ignored_channels: optional [R] f32 mask, 1.0 = check the channel."""
+    alloc, used, req = cluster.allocatable, cluster.requested, batch.req
+    free_ok = alloc[None, :, :] >= req[:, None, :] + used[None, :, :]  # [B, N, R]
+    R = alloc.shape[1]
+    ch = jnp.arange(R)
+    # pod count is always checked; cpu/mem/ephemeral checked whenever the pod
+    # requests anything at all; scalar channels only when requested.
+    is_fixed = (ch < N_FIXED_CHANNELS) & (ch != CH_PODS)
+    scalar_req = req[:, None, :] > 0
+    check = jnp.where(is_fixed, True, scalar_req)
+    if ignored_channels is not None:
+        check = jnp.logical_and(check, ignored_channels > 0)
+    res_ok = jnp.all(free_ok | ~check | (ch == CH_PODS), axis=-1)
+    pods_ok = free_ok[:, :, CH_PODS]
+    nonpods = jnp.where(ch == CH_PODS, 0.0, req)
+    zero_req = jnp.all(nonpods == 0, axis=-1)  # [B]
+    return pods_ok & (zero_req[:, None] | res_ok)
+
+
+def node_name_filter(cluster, batch) -> jnp.ndarray:
+    """NodeName (reference: nodename/node_name.go:51)."""
+    has = jnp.take(cluster.kv.T, jnp.clip(batch.node_name_kvid, 0, None), axis=0)
+    named_ok = has & (batch.node_name_kvid >= 0)[:, None]
+    return jnp.where(batch.has_node_name[:, None], named_ok, True)
+
+
+def node_unschedulable_filter(cluster, batch) -> jnp.ndarray:
+    """NodeUnschedulable (reference: nodeunschedulable/node_unschedulable.go:51)."""
+    return ~(cluster.unschedulable[None, :]
+             & ~batch.tolerates_unschedulable[:, None])
+
+
+def node_ports_filter(cluster, batch) -> jnp.ndarray:
+    """NodePorts (reference: nodeports/node_ports.go:108; wildcard semantics
+    encoded at intern time, see state/tensors.py port_ids)."""
+    conflicts = jnp.einsum("bp,np->bn", batch.ports_hot, _f(cluster.ports),
+                           preferred_element_type=jnp.float32)
+    return conflicts < 0.5
+
+
+def taint_filter(cluster, batch) -> jnp.ndarray:
+    """TaintToleration: untolerated NoSchedule/NoExecute taint fails
+    (reference: tainttoleration/taint_toleration.go:54-72)."""
+    untol_hard = _f(~batch.tolerated) * _f(cluster.taint_is_hard)[None, :]
+    hits = jnp.einsum("bt,nt->bn", untol_hard, _f(cluster.taints),
+                      preferred_element_type=jnp.float32)
+    return hits < 0.5
+
+
+def node_affinity_filter(cluster, batch) -> jnp.ndarray:
+    """NodeAffinity + spec.nodeSelector (reference:
+    nodeaffinity/node_affinity.go:54, plugins/helper/node_affinity.go
+    PodMatchesNodeSelectorAndAffinityTerms).  Also reused by the topology
+    spread kernels as the node-eligibility mask."""
+    B = batch.req.shape[0]
+    sel_ok = match_selectors(batch.node_selector, cluster.kv, cluster.keymask,
+                             cluster.num)  # [B, N]
+    term_m = match_selectors(batch.rna_sel, cluster.kv, cluster.keymask,
+                             cluster.num)  # [B*Tn, N]
+    Tn = batch.rna_valid.shape[1]
+    term_m = term_m.reshape(B, Tn, -1)
+    any_term = jnp.any(term_m & batch.rna_valid[:, :, None], axis=1)
+    rna_ok = jnp.where(batch.has_rna[:, None], any_term, True)
+    return sel_ok & rna_ok
+
+
+# ---------------------------------------------------------------------------
+# PodTopologySpread
+
+
+class SpreadState(NamedTuple):
+    node_counts: jnp.ndarray   # [B, C, N] matching-pod counts per node
+    pair_counts: jnp.ndarray   # [B*C, L] counts per registered pair
+    registered: jnp.ndarray    # [B*C, L] bool pair registered from eligible nodes
+    node_pair: jnp.ndarray     # [B*C, N] node's pair id per constraint
+    has_key: jnp.ndarray       # [B, C, N] node has the topology key
+    eligible: jnp.ndarray      # [B, N] affinity-ok nodes with all constraint keys
+    any_eligible: jnp.ndarray  # [B]
+
+
+def _spread_state(cluster, batch, constraints, affinity_ok, count_mask_nodes) -> SpreadState:
+    """Shared machinery of hard-filter and soft-score spreading.
+
+    constraints: batch.spread or batch.spread_soft.
+    count_mask_nodes: [B, N] bool — nodes whose pods are counted into pair
+    sums (PreFilter counts every node's pods into registered pairs; PreScore
+    counts only affinity-matching nodes with all keys)."""
+    B, C = constraints.topo_key.shape
+    N = cluster.allocatable.shape[0]
+    L = cluster.kv.shape[1]
+
+    # matching existing pods: same namespace, selector, non-terminating
+    # (reference: podtopologyspread/common.go:87 countPodsMatchSelector)
+    m = match_selectors(constraints.sel, cluster.pod_kv, cluster.pod_key)  # [B*C, P]
+    ns_ok = jnp.einsum("bn,pn->bp", batch.ns_hot, cluster.pod_ns_hot,
+                       preferred_element_type=jnp.float32) > 0.5
+    countable = cluster.pod_valid & ~cluster.pod_terminating
+    m = m.reshape(B, C, -1) & ns_ok[:, None, :] & countable[None, None, :]
+    node_counts = per_node_counts(m.reshape(B * C, -1), cluster.pod_node,
+                                  N).reshape(B, C, N)
+
+    node_pair = node_topo_pairs(cluster, constraints.topo_key.reshape(-1))  # [B*C, N]
+    has_key = ((node_pair >= 0).reshape(B, C, N)
+               & constraints.topo_known.reshape(B, C)[:, :, None])
+    node_pair = jnp.where(has_key.reshape(B * C, N), node_pair, -1)
+    valid_c = constraints.valid  # [B, C]
+    all_keys = jnp.all(has_key | ~valid_c[:, :, None], axis=1)  # [B, N]
+    eligible = affinity_ok & cluster.node_valid[None, :] & all_keys
+    any_eligible = jnp.any(eligible, axis=1)
+
+    elig_bc = jnp.broadcast_to(eligible[:, None, :], (B, C, N)).reshape(B * C, N)
+    registered = pair_scatter(elig_bc, node_pair, L) > 0.5
+    counted = jnp.broadcast_to(count_mask_nodes[:, None, :], (B, C, N)).reshape(B * C, N)
+    pair_counts = pair_scatter(node_counts.reshape(B * C, N) * _f(counted),
+                               node_pair, L)
+    pair_counts = jnp.where(registered, pair_counts, 0.0)
+    return SpreadState(node_counts=node_counts, pair_counts=pair_counts,
+                       registered=registered, node_pair=node_pair,
+                       has_key=has_key, eligible=eligible,
+                       any_eligible=any_eligible)
+
+
+def spread_filter(cluster, batch, affinity_ok) -> jnp.ndarray:
+    """PodTopologySpread hard constraints
+    (reference: podtopologyspread/filtering.go:200-283 calPreFilterState/Filter)."""
+    cons = batch.spread
+    B, C = cons.topo_key.shape
+    N = cluster.allocatable.shape[0]
+    st = _spread_state(cluster, batch, cons, affinity_ok,
+                       cluster.node_valid[None, :] & jnp.ones((B, N), bool))
+    # min match per constraint over *registered* pairs
+    big = jnp.float32(2**31)
+    masked = jnp.where(st.registered, st.pair_counts, big)
+    min_match = jnp.min(masked, axis=1).reshape(B, C)  # [B, C]
+    match_num = pair_gather(jnp.where(st.registered, st.pair_counts, 0.0),
+                            st.node_pair).reshape(B, C, N)
+    # unregistered pair => matchNum 0 (reference Filter: nil *tpCount)
+    self_m = _f(cons.self_match)[:, :, None]
+    skew = match_num + self_m - min_match[:, :, None]
+    c_ok = st.has_key & (skew <= cons.max_skew[:, :, None])
+    ok = jnp.all(c_ok | ~cons.valid[:, :, None], axis=1)
+    has_any = jnp.any(cons.valid, axis=1)
+    # empty preFilterState (no eligible nodes anywhere) tolerates every pod
+    return jnp.where(has_any[:, None] & st.any_eligible[:, None], ok, True)
+
+
+def spread_soft_score(cluster, batch, feasible, affinity_ok,
+                      hostname_topokey: int) -> jnp.ndarray:
+    """PodTopologySpread soft constraints scoring, already normalized
+    (reference: podtopologyspread/scoring.go PreScore/Score/NormalizeScore)."""
+    cons = batch.spread_soft
+    B, C = cons.topo_key.shape
+    N = cluster.allocatable.shape[0]
+    count_nodes = affinity_ok & cluster.node_valid[None, :]
+    # pairs are registered from *filtered* nodes only
+    st = _spread_state(cluster, batch, cons, feasible, count_nodes)
+    is_host = (cons.topo_key == hostname_topokey) & cons.topo_known
+    valid = cons.valid
+
+    # ignored nodes: filtered but missing some constraint key
+    all_keys = jnp.all(st.has_key | ~valid[:, :, None], axis=1)  # [B, N]
+    ignored = feasible & ~all_keys
+    scored = feasible & all_keys
+
+    # hostname pairs are not registered (per-node counts used directly);
+    # emulate by removing hostname constraints from pair space
+    reg = st.registered.reshape(B, C, -1) & ~is_host[:, :, None]
+    topo_size = jnp.sum(_f(reg), axis=2)  # [B, C]
+    n_scored = jnp.sum(_f(scored), axis=1)  # [B]
+    size = jnp.where(is_host, n_scored[:, None], topo_size)
+    weight = jnp.log(size + 2.0)  # reference: scoring.go:286
+
+    pair_cnt = pair_gather(jnp.where(reg.reshape(B * C, -1), st.pair_counts, 0.0),
+                           st.node_pair).reshape(B, C, N)
+    cnt = jnp.where(is_host[:, :, None], st.node_counts, pair_cnt)
+    # adjustForMaxSkew (scoring.go:294)
+    ms = cons.max_skew[:, :, None]
+    cnt = jnp.where(cnt < ms, ms - 1.0, cnt)
+    contrib = jnp.where((valid & cons.topo_known)[:, :, None] & st.has_key,
+                        cnt * weight[:, :, None], 0.0)
+    raw = jnp.floor(jnp.sum(contrib, axis=1))  # int64(score)
+    raw = jnp.where(ignored, 0.0, raw)
+
+    # NormalizeScore (scoring.go:210-257): min/max over non-ignored filtered
+    sel = scored
+    big = jnp.float32(2**62)
+    min_s = jnp.min(jnp.where(sel, raw, big), axis=1, keepdims=True)
+    max_s = jnp.max(jnp.where(sel, raw, -big), axis=1, keepdims=True)
+    max_s = jnp.maximum(max_s, 0.0)
+    norm = jnp.where(max_s > 0,
+                     jnp.floor(MAX_NODE_SCORE * (max_s + jnp.minimum(min_s, big)
+                                                 - raw) / jnp.maximum(max_s, 1.0)),
+                     MAX_NODE_SCORE)
+    out = jnp.where(ignored, 0.0, norm)
+    # no soft constraints => every filtered node scores MaxNodeScore (the
+    # reference's maxScore==0 branch)
+    has_any = jnp.any(valid, axis=1, keepdims=True)
+    out = jnp.where(has_any, out, MAX_NODE_SCORE)
+    return jnp.where(feasible, out, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# InterPodAffinity
+
+
+def _pod_term_matches(cluster, terms, B: int) -> jnp.ndarray:
+    """Match pod-side affinity terms against existing pods -> [B, T, P]."""
+    m = match_selectors(terms.sel, cluster.pod_kv, cluster.pod_key)  # [B*T, P]
+    T = terms.valid.shape[1]
+    m = m.reshape(B, T, -1)
+    ns_ok = jnp.einsum("btn,pn->btp", terms.ns_hot, cluster.pod_ns_hot,
+                       preferred_element_type=jnp.float32) > 0.5
+    return m & ns_ok & cluster.pod_valid[None, None, :]
+
+
+def interpod_filter(cluster, batch) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """InterPodAffinity filter.  Returns (ok, affinity_unresolvable) where
+    affinity_unresolvable marks required-affinity failures
+    (UnschedulableAndUnresolvable, reference: filtering.go:371-396)."""
+    B = batch.req.shape[0]
+    N = cluster.allocatable.shape[0]
+    L = cluster.kv.shape[1]
+
+    # --- incoming required affinity (filtering.go:342 satisfyPodAffinity)
+    ra = batch.ra
+    Tr = ra.valid.shape[1]
+    m = _pod_term_matches(cluster, ra, B)  # [B, T, P]
+    match_all = jnp.all(m | ~ra.valid[:, :, None], axis=1)  # [B, P]
+    has_ra = jnp.any(ra.valid, axis=1)  # [B]
+    ep_pair = pod_topo_pairs(cluster, ra.topo_key.reshape(-1))  # [B*T, P]
+    contrib = jnp.broadcast_to(match_all[:, None, :], m.shape).reshape(B * Tr, -1)
+    pair_counts = pair_scatter(contrib, ep_pair, L)  # [B*T, L]
+    node_pair = node_topo_pairs(cluster, ra.topo_key.reshape(-1))  # [B*T, N]
+    node_has_key = (node_pair >= 0).reshape(B, Tr, N) & ra.topo_known[:, :, None]
+    cnt = pair_gather(pair_counts, node_pair).reshape(B, Tr, N)
+    term_ok = node_has_key & (cnt > 0.5)
+    aff_ok = jnp.all(term_ok | ~ra.valid[:, :, None], axis=1)
+    # bootstrap: no matches anywhere + pod matches its own terms
+    # (filtering.go:356-366); node must still carry every topology key
+    no_matches = jnp.sum(pair_counts.reshape(B, -1), axis=1) < 0.5
+    self_all = jnp.all(ra.self_match | ~ra.valid, axis=1) & has_ra
+    all_keys = jnp.all(node_has_key | ~ra.valid[:, :, None], axis=1)
+    aff_ok = aff_ok | ((no_matches & self_all)[:, None] & all_keys)
+    aff_ok = jnp.where(has_ra[:, None], aff_ok, True)
+
+    # --- incoming required anti-affinity (filtering.go:329 satisfyPodAntiAffinity)
+    raa = batch.raa
+    Ta = raa.valid.shape[1]
+    ma = _pod_term_matches(cluster, raa, B).reshape(B * Ta, -1)
+    ep_pair_a = pod_topo_pairs(cluster, raa.topo_key.reshape(-1))
+    pc_a = pair_scatter(ma, ep_pair_a, L)
+    np_a = node_topo_pairs(cluster, raa.topo_key.reshape(-1))
+    has_key_a = (np_a >= 0).reshape(B, Ta, N) & raa.topo_known[:, :, None]
+    cnt_a = pair_gather(pc_a, np_a).reshape(B, Ta, N)
+    anti_fail = jnp.any(has_key_a & (cnt_a > 0.5) & raa.valid[:, :, None], axis=1)
+
+    # --- existing pods' required anti-affinity
+    # (filtering.go:314 satisfyExistingPodsAntiAffinity)
+    ft = cluster.filter_terms
+    em = match_selectors(ft.sel, batch.kv_hot, batch.key_hot)  # [Et, B]
+    ens = jnp.einsum("en,bn->eb", ft.ns_hot, batch.ns_hot,
+                     preferred_element_type=jnp.float32) > 0.5
+    em = em & ens & ft.valid[:, None]
+    pod_topo = jnp.take(cluster.topo_pair, jnp.clip(cluster.pod_node, 0, None), axis=0)
+    e_pair = jnp.take_along_axis(pod_topo[jnp.clip(ft.pod_idx, 0, None)],
+                                 ft.topo_key[:, None], axis=1)[:, 0]  # [Et]
+    owner_ok = jnp.take(cluster.pod_valid, jnp.clip(ft.pod_idx, 0, None))
+    e_pair = jnp.where(ft.valid & owner_ok, e_pair, -1)
+    ids = jnp.where(e_pair >= 0, e_pair, L)
+    counts_lb = jax.ops.segment_sum(_f(em), ids, num_segments=L + 1)[:L]  # [L, B]
+    exist_fail = jnp.einsum("bl,nl->bn", counts_lb.T, _f(cluster.kv),
+                            preferred_element_type=jnp.float32) > 0.5
+
+    ok = aff_ok & ~anti_fail & ~exist_fail
+    return ok, ~aff_ok
+
+
+def interpod_score(cluster, batch, feasible) -> jnp.ndarray:
+    """InterPodAffinity scoring, already normalized (reference: scoring.go)."""
+    B = batch.req.shape[0]
+    L = cluster.kv.shape[1]
+
+    # incoming pod's preferred terms vs existing pods
+    pt = batch.pref
+    T = pt.valid.shape[1]
+    m = _pod_term_matches(cluster, pt, B)  # [B, T, P]
+    ep_pair = pod_topo_pairs(cluster, pt.topo_key.reshape(-1))  # [B*T, P]
+    data = (_f(m) * pt.weight[:, :, None] * _f(pt.valid)[:, :, None])
+    counts = pair_scatter(data.reshape(B * T, -1), ep_pair, L)
+    counts = jnp.sum(counts.reshape(B, T, L), axis=1)  # [B, L]
+
+    # existing pods' terms vs incoming pod
+    st = cluster.score_terms
+    em = match_selectors(st.sel, batch.kv_hot, batch.key_hot)  # [Es, B]
+    ens = jnp.einsum("en,bn->eb", st.ns_hot, batch.ns_hot,
+                     preferred_element_type=jnp.float32) > 0.5
+    owner_ok = jnp.take(cluster.pod_valid, jnp.clip(st.pod_idx, 0, None))
+    em = _f(em & ens & st.valid[:, None] & owner_ok[:, None]) * st.weight[:, None]
+    pod_topo = jnp.take(cluster.topo_pair, jnp.clip(cluster.pod_node, 0, None), axis=0)
+    e_pair = jnp.take_along_axis(pod_topo[jnp.clip(st.pod_idx, 0, None)],
+                                 st.topo_key[:, None], axis=1)[:, 0]
+    e_pair = jnp.where(st.valid & owner_ok, e_pair, -1)
+    ids = jnp.where(e_pair >= 0, e_pair, L)
+    counts2 = jax.ops.segment_sum(em, ids, num_segments=L + 1)[:L].T  # [B, L]
+    counts = counts + counts2
+
+    raw = jnp.einsum("bl,nl->bn", counts, _f(cluster.kv),
+                     preferred_element_type=jnp.float32)
+
+    # NormalizeScore (scoring.go:237-271): min/max start at 0; skip entirely
+    # when the topologyScore map is empty
+    any_counts = jnp.any(counts != 0, axis=1, keepdims=True)
+    big = jnp.float32(2**62)
+    max_c = jnp.maximum(jnp.max(jnp.where(feasible, raw, -big), axis=1,
+                                keepdims=True), 0.0)
+    min_c = jnp.minimum(jnp.min(jnp.where(feasible, raw, big), axis=1,
+                                keepdims=True), 0.0)
+    diff = max_c - min_c
+    norm = jnp.where(diff > 0,
+                     jnp.floor(MAX_NODE_SCORE * (raw - min_c)
+                               / jnp.maximum(diff, 1.0)),
+                     0.0)
+    out = jnp.where(any_counts, norm, raw)
+    return jnp.where(feasible, out, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# resource scorers
+
+
+def _alloc_req(cluster, batch):
+    """(requested-with-pod, allocatable) for cpu/mem using NonZeroRequested
+    (reference: noderesources/resource_allocation.go:108-117)."""
+    req_cpu = cluster.nonzero_requested[None, :, 0] + batch.nonzero_req[:, 0][:, None]
+    req_mem = cluster.nonzero_requested[None, :, 1] + batch.nonzero_req[:, 1][:, None]
+    alloc_cpu = cluster.allocatable[None, :, CH_CPU]
+    alloc_mem = cluster.allocatable[None, :, CH_MEM]
+    return req_cpu, req_mem, alloc_cpu, alloc_mem
+
+
+def balanced_allocation_score(cluster, batch) -> jnp.ndarray:
+    """(1 - |cpuFraction - memFraction|) * MaxNodeScore
+    (reference: noderesources/balanced_allocation.go:83-113)."""
+    req_cpu, req_mem, alloc_cpu, alloc_mem = _alloc_req(cluster, batch)
+    cpu_frac = jnp.where(alloc_cpu > 0, req_cpu / jnp.maximum(alloc_cpu, 1.0), 1.0)
+    mem_frac = jnp.where(alloc_mem > 0, req_mem / jnp.maximum(alloc_mem, 1.0), 1.0)
+    diff = jnp.abs(cpu_frac - mem_frac)
+    score = jnp.floor((1.0 - diff) * MAX_NODE_SCORE)
+    return jnp.where((cpu_frac >= 1.0) | (mem_frac >= 1.0), 0.0, score)
+
+
+def _weighted_resource_score(cluster, batch, per_resource, cpu_weight=1.0,
+                             mem_weight=1.0) -> jnp.ndarray:
+    req_cpu, req_mem, alloc_cpu, alloc_mem = _alloc_req(cluster, batch)
+    s_cpu = per_resource(req_cpu, alloc_cpu)
+    s_mem = per_resource(req_mem, alloc_mem)
+    total = s_cpu * cpu_weight + s_mem * mem_weight
+    return _idiv(total, cpu_weight + mem_weight)
+
+
+def least_allocated_score(cluster, batch) -> jnp.ndarray:
+    """(capacity - requested) * MaxNodeScore / capacity per resource, averaged
+    (reference: noderesources/least_allocated.go:95-117)."""
+    def one(req, cap):
+        s = _idiv((cap - req) * MAX_NODE_SCORE, jnp.maximum(cap, 1.0))
+        return jnp.where((cap <= 0) | (req > cap), 0.0, s)
+    return _weighted_resource_score(cluster, batch, one)
+
+
+def most_allocated_score(cluster, batch) -> jnp.ndarray:
+    """requested * MaxNodeScore / capacity (reference: most_allocated.go:101-117)."""
+    def one(req, cap):
+        s = _idiv(req * MAX_NODE_SCORE, jnp.maximum(cap, 1.0))
+        return jnp.where((cap <= 0) | (req > cap), 0.0, s)
+    return _weighted_resource_score(cluster, batch, one)
+
+
+# ---------------------------------------------------------------------------
+# remaining scorers
+
+
+def node_affinity_score(cluster, batch) -> jnp.ndarray:
+    """Sum of matched preferred node-affinity term weights (raw; normalized
+    by default_normalize) (reference: nodeaffinity/node_affinity.go:65-103)."""
+    B = batch.req.shape[0]
+    Tp = batch.pna_valid.shape[1]
+    m = match_selectors(batch.pna_sel, cluster.kv, cluster.keymask, cluster.num)
+    m = m.reshape(B, Tp, -1)
+    w = batch.pna_weight * _f(batch.pna_valid)
+    return jnp.einsum("bt,btn->bn", w, _f(m), preferred_element_type=jnp.float32)
+
+
+def taint_toleration_score(cluster, batch) -> jnp.ndarray:
+    """Count of untolerated PreferNoSchedule taints (raw; reverse-normalized)
+    (reference: tainttoleration/taint_toleration.go:123-141)."""
+    untol_prefer = _f(~batch.tolerated) * _f(cluster.taint_is_prefer)[None, :]
+    return jnp.einsum("bt,nt->bn", untol_prefer, _f(cluster.taints),
+                      preferred_element_type=jnp.float32)
+
+
+_MB = 1024.0 * 1024.0
+IMAGE_MIN_THRESHOLD = 23.0 * _MB       # reference: image_locality.go:44
+IMAGE_MAX_CONTAINER_THRESHOLD = 1000.0 * _MB
+
+
+def image_locality_score(cluster, batch) -> jnp.ndarray:
+    """Scaled sum of present image sizes (reference: image_locality.go:82-110)."""
+    scaled = _f(cluster.images) * jnp.floor(cluster.image_size
+                                            * cluster.image_spread)[None, :]
+    s = jnp.einsum("bi,ni->bn", batch.images_hot, scaled,
+                   preferred_element_type=jnp.float32)
+    max_thr = IMAGE_MAX_CONTAINER_THRESHOLD * jnp.maximum(batch.n_containers, 1.0)
+    s = jnp.clip(s, IMAGE_MIN_THRESHOLD, max_thr[:, None])
+    return _idiv(MAX_NODE_SCORE * (s - IMAGE_MIN_THRESHOLD),
+                 max_thr[:, None] - IMAGE_MIN_THRESHOLD)
+
+
+def prefer_avoid_pods_score(cluster, batch) -> jnp.ndarray:
+    """MaxNodeScore unless the node's preferAvoidPods annotation names the
+    pod's RC/RS controller (reference: node_prefer_avoid_pods.go:46-81)."""
+    hit = jnp.take(cluster.avoid_hot.T, jnp.clip(batch.avoid_id, 0, None), axis=0)
+    avoided = hit & (batch.avoid_id >= 0)[:, None]
+    return jnp.where(avoided, 0.0, MAX_NODE_SCORE)
+
+
+def default_spread_score(cluster, batch) -> jnp.ndarray:
+    """DefaultPodTopologySpread raw score: count of same-namespace,
+    non-terminating pods on the node matched by the combined controller
+    selector (reference: default_pod_topology_spread.go:74-97, 200-215)."""
+    N = cluster.allocatable.shape[0]
+    m = match_selectors(batch.spread_selector, cluster.pod_kv, cluster.pod_key)
+    ns_ok = jnp.einsum("bn,pn->bp", batch.ns_hot, cluster.pod_ns_hot,
+                       preferred_element_type=jnp.float32) > 0.5
+    countable = cluster.pod_valid & ~cluster.pod_terminating
+    m = m & ns_ok & countable[None, :]
+    counts = per_node_counts(m, cluster.pod_node, N)
+    return jnp.where(batch.spread_skip[:, None], 0.0, counts)
+
+
+ZONE_WEIGHTING = 2.0 / 3.0  # reference: default_pod_topology_spread.go:44
+
+
+def default_spread_normalize(cluster, batch, raw, feasible) -> jnp.ndarray:
+    """Zone-aware normalization (reference: default_pod_topology_spread.go:104-166)."""
+    Z = int(cluster.zone_id.shape[0])  # upper bound on zone count: N
+    big = jnp.float32(2**62)
+    raw_f = jnp.where(feasible, raw, 0.0)
+    max_node = jnp.max(jnp.where(feasible, raw, -big), axis=1, keepdims=True)
+    max_node = jnp.maximum(max_node, 0.0)
+
+    zid = jnp.where((cluster.zone_id >= 0) & cluster.node_valid, cluster.zone_id, Z)
+    counts_by_zone = jax.ops.segment_sum(raw_f.T, zid, num_segments=Z + 1)[:Z]  # [Z, B]
+    counts_by_zone = counts_by_zone.T  # [B, Z]
+    have_zone_node = feasible & (cluster.zone_id >= 0)[None, :]
+    have_zones = jnp.any(have_zone_node, axis=1, keepdims=True)
+    max_zone = jnp.maximum(jnp.max(counts_by_zone, axis=1, keepdims=True), 0.0)
+
+    f_score = jnp.where(max_node > 0,
+                        MAX_NODE_SCORE * (max_node - raw) / jnp.maximum(max_node, 1.0),
+                        MAX_NODE_SCORE)
+    node_zone_count = jnp.take_along_axis(
+        jnp.pad(counts_by_zone, ((0, 0), (0, 1))),
+        jnp.broadcast_to(jnp.clip(cluster.zone_id, 0, None)[None, :],
+                         raw.shape), axis=1)
+    zone_score = jnp.where(max_zone > 0,
+                           MAX_NODE_SCORE * (max_zone - node_zone_count)
+                           / jnp.maximum(max_zone, 1.0),
+                           MAX_NODE_SCORE)
+    with_zone = (f_score * (1.0 - ZONE_WEIGHTING)) + ZONE_WEIGHTING * zone_score
+    out = jnp.where(have_zones & (cluster.zone_id >= 0)[None, :], with_zone, f_score)
+    out = jnp.floor(out)
+    out = jnp.where(batch.spread_skip[:, None], 0.0, out)
+    return jnp.where(feasible, out, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# normalization helpers
+
+
+def default_normalize(raw, feasible, reverse: bool) -> jnp.ndarray:
+    """reference: plugins/helper/normalize_score.go:26 (DefaultNormalizeScore)."""
+    big = jnp.float32(2**62)
+    max_c = jnp.maximum(jnp.max(jnp.where(feasible, raw, -big), axis=1,
+                                keepdims=True), 0.0)
+    scaled = _idiv(MAX_NODE_SCORE * raw, jnp.maximum(max_c, 1.0))
+    if reverse:
+        scaled = MAX_NODE_SCORE - scaled
+    zero_case = MAX_NODE_SCORE if reverse else 0.0
+    out = jnp.where(max_c > 0, scaled, zero_case)
+    return jnp.where(feasible, out, 0.0)

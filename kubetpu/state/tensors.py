@@ -88,8 +88,9 @@ class ClusterTensors(NamedTuple):
     taints: jnp.ndarray             # [N, T] bool
     ports: jnp.ndarray              # [N, P] bool
     images: jnp.ndarray             # [N, I] bool
-    avoid_pods: jnp.ndarray         # [N, 2] bool — preferAvoidPods annotation present
-                                    #   for (ReplicationController, ReplicaSet) owners
+    avoid_hot: jnp.ndarray          # [N, AV] bool — node's preferAvoidPods entries
+                                    #   over the (controller kind, uid) vocab
+    zone_id: jnp.ndarray            # [N] i32 GetZoneKey id (-1 no zone info)
     # vocab-side metadata ---------------------------------------------------
     taint_is_hard: jnp.ndarray      # [T] bool (NoSchedule | NoExecute)
     taint_is_prefer: jnp.ndarray    # [T] bool (PreferNoSchedule)
@@ -101,6 +102,7 @@ class ClusterTensors(NamedTuple):
     pod_ns_hot: jnp.ndarray         # [P, NS] f32 one-hot
     pod_node: jnp.ndarray           # [P] i32 node row (-1 invalid)
     pod_valid: jnp.ndarray          # [P] bool
+    pod_terminating: jnp.ndarray    # [P] bool (deletionTimestamp set)
     # existing pods' terms --------------------------------------------------
     filter_terms: ExistingTerms     # required anti-affinity (filter)
     score_terms: ExistingTerms      # preferred +/-, required x hardWeight (score)
@@ -115,32 +117,9 @@ class HostClusterArrays(NamedTuple):
     arrays: dict
 
     def to_device(self) -> ClusterTensors:
-        d = self.arrays
-        ft = d["filter_terms"]
-        st = d["score_terms"]
-        def put(x):
-            return jnp.asarray(x)
-        return ClusterTensors(
-            allocatable=put(d["allocatable"]), requested=put(d["requested"]),
-            nonzero_requested=put(d["nonzero_requested"]),
-            node_valid=put(d["node_valid"]), unschedulable=put(d["unschedulable"]),
-            kv=put(d["kv"]), keymask=put(d["keymask"]), num=put(d["num"]),
-            topo_pair=put(d["topo_pair"]), taints=put(d["taints"]),
-            ports=put(d["ports"]), images=put(d["images"]),
-            avoid_pods=put(d["avoid_pods"]),
-            taint_is_hard=put(d["taint_is_hard"]),
-            taint_is_prefer=put(d["taint_is_prefer"]),
-            image_size=put(d["image_size"]), image_spread=put(d["image_spread"]),
-            pod_kv=put(d["pod_kv"]), pod_key=put(d["pod_key"]),
-            pod_ns_hot=put(d["pod_ns_hot"]), pod_node=put(d["pod_node"]),
-            pod_valid=put(d["pod_valid"]),
-            filter_terms=ExistingTerms(*[put(x) if not isinstance(x, SelectorSet)
-                                         else SelectorSet(*[put(y) for y in x])
-                                         for x in ft]),
-            score_terms=ExistingTerms(*[put(x) if not isinstance(x, SelectorSet)
-                                        else SelectorSet(*[put(y) for y in x])
-                                        for x in st]),
-        )
+        import jax
+        vals = [self.arrays[f] for f in ClusterTensors._fields]
+        return jax.tree.map(jnp.asarray, ClusterTensors(*vals))
 
 
 # Well-known topology keys are always present so zone/hostname spreading
@@ -186,6 +165,11 @@ class SnapshotBuilder:
                 t.image.intern(_norm_image(name))
             for r in ni.allocatable.scalar_resources:
                 t.rname.intern(r)
+            zk = zone_key(node)
+            if zk:
+                t.zone.intern(zk)
+            for kind, uid in _avoid_entries(node):
+                t.avoid.intern((kind, uid))
             for triple in ni.used_ports:
                 for pid in _port_ids_node(triple):
                     t.port.intern(pid)
@@ -211,6 +195,7 @@ class SnapshotBuilder:
         R = N_FIXED_CHANNELS + t.rname.cap
         L, K, TK = t.kv.cap, t.key.cap, t.topokey.cap
         T, P, I, NS = t.taint.cap, t.port.cap, t.image.cap, t.ns.cap
+        AV = t.avoid.cap
         n_pods = sum(len(ni.pods) for ni in nodes)
         PP = pow2_bucket(n_pods, 8)
 
@@ -227,7 +212,8 @@ class SnapshotBuilder:
             "taints": np.zeros((N, T), bool),
             "ports": np.zeros((N, P), bool),
             "images": np.zeros((N, I), bool),
-            "avoid_pods": np.zeros((N, 2), bool),
+            "avoid_hot": np.zeros((N, AV), bool),
+            "zone_id": np.full((N,), -1, np.int32),
             "taint_is_hard": np.zeros((T,), bool),
             "taint_is_prefer": np.zeros((T,), bool),
             "image_size": np.zeros((I,), np.float32),
@@ -237,6 +223,7 @@ class SnapshotBuilder:
             "pod_ns_hot": np.zeros((PP, NS), np.float32),
             "pod_node": np.full((PP,), -1, np.int32),
             "pod_valid": np.zeros((PP,), bool),
+            "pod_terminating": np.zeros((PP,), bool),
         }
 
         # vocab metadata
@@ -289,12 +276,17 @@ class SnapshotBuilder:
                 d["image_size"][ii] = size
             for ii in np.nonzero(d["images"][n_idx])[0]:
                 image_nodes[ii] += 1
-            d["avoid_pods"][n_idx] = _avoid_pods_flags(node)
+            for kind, uid in _avoid_entries(node):
+                d["avoid_hot"][n_idx, t.avoid.get((kind, uid))] = True
+            zk = zone_key(node)
+            if zk:
+                d["zone_id"][n_idx] = t.zone.get(zk)
 
             for pi in ni.pods:
                 p = pi.pod
                 d["pod_node"][pod_row] = n_idx
                 d["pod_valid"][pod_row] = True
+                d["pod_terminating"][pod_row] = p.metadata.deletion_timestamp is not None
                 d["pod_ns_hot"][pod_row, t.ns.get(p.namespace)] = 1.0
                 for k, v in p.metadata.labels.items():
                     d["pod_kv"][pod_row, t.kv.get((k, v))] = True
@@ -399,22 +391,32 @@ def port_ids_pod(triple: Tuple[str, str, int]):
     return [(proto, ip, port), (proto, _WILD, port)]
 
 
-def _avoid_pods_flags(node: api.Node) -> np.ndarray:
-    """[has RC avoid entry, has RS avoid entry] from the preferAvoidPods
-    annotation (reference: nodepreferavoidpods/node_prefer_avoid_pods.go:60)."""
-    out = np.zeros((2,), bool)
+def _avoid_entries(node: api.Node) -> List[Tuple[str, str]]:
+    """(kind, uid) pairs from the preferAvoidPods annotation (reference:
+    pkg/apis/core/v1/helper/helpers.go:239 GetAvoidPodsFromNodeAnnotations,
+    matched by kind+UID in nodepreferavoidpods/node_prefer_avoid_pods.go:76)."""
     raw = node.metadata.annotations.get(api.PREFER_AVOID_PODS_ANNOTATION_KEY)
     if not raw:
-        return out
+        return []
     import json
+    out = []
     try:
         doc = json.loads(raw)
         for entry in doc.get("preferAvoidPods", []):
-            kind = entry.get("podSignature", {}).get("podController", {}).get("kind", "")
-            if kind == "ReplicationController":
-                out[0] = True
-            elif kind == "ReplicaSet":
-                out[1] = True
+            ctrl = entry.get("podSignature", {}).get("podController", {})
+            out.append((ctrl.get("kind", ""), ctrl.get("uid", "")))
     except (ValueError, AttributeError):
-        pass
+        return []
     return out
+
+
+def zone_key(node: api.Node) -> str:
+    """region:zone key for zone-aware spreading
+    (reference: pkg/util/node/node.go:148 GetZoneKey)."""
+    labels = node.metadata.labels
+    # legacy failure-domain labels take precedence (reference behavior)
+    region = labels.get(api.LABEL_REGION_LEGACY, labels.get(api.LABEL_REGION, ""))
+    zone = labels.get(api.LABEL_ZONE_LEGACY, labels.get(api.LABEL_ZONE, ""))
+    if not region and not zone:
+        return ""
+    return region + ":\x00:" + zone

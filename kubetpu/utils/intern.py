@@ -87,6 +87,9 @@ class InternTable:
         self.ns = Vocab("ns")
         self.rname = Vocab("rname")
         self.topokey = Vocab("topokey")
+        self.zone = Vocab("zone")    # GetZoneKey strings (region:zone)
+        self.avoid = Vocab("avoid")  # (controller kind, uid) pairs from
+                                     # preferAvoidPods annotations
 
     def intern_labels(self, labels: Dict[str, str]) -> Tuple[List[int], List[int]]:
         """Intern a label map; returns (kv ids, key ids)."""

@@ -1,0 +1,53 @@
+"""Mini harness for kernel tests: build a cluster from api objects, run the
+jitted filter+score program, return trimmed numpy results."""
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from kubetpu.api import types as api
+from kubetpu.framework.types import NodeInfo, PodInfo
+from kubetpu.models.batch import PodBatchBuilder
+from kubetpu.models import programs
+from kubetpu.state.tensors import SnapshotBuilder
+
+
+class Result:
+    def __init__(self, res, chosen, n_nodes, n_pods, node_names):
+        self.feasible = np.asarray(res.feasible)[:n_pods, :n_nodes]
+        self.unresolvable = np.asarray(res.unresolvable)[:n_pods, :n_nodes]
+        self.scores = np.asarray(res.scores)[:n_pods, :n_nodes]
+        self.plugin_scores = {k: np.asarray(v)[:n_pods, :n_nodes]
+                              for k, v in res.plugin_scores.items()}
+        self.chosen = np.asarray(chosen)[:n_pods]
+        self.node_names = node_names
+
+
+def run_cluster(nodes: List[api.Node],
+                existing: Optional[Dict[str, List[api.Pod]]] = None,
+                pending: Sequence[api.Pod] = (),
+                filters=programs.DEFAULT_FILTER_PLUGINS,
+                scores=programs.DEFAULT_SCORE_PLUGINS,
+                spread_selectors=None,
+                seed: int = 0) -> Result:
+    existing = existing or {}
+    infos = []
+    for n in nodes:
+        ni = NodeInfo(n)
+        for p in existing.get(n.name, []):
+            p.spec.node_name = n.name
+            ni.add_pod(p)
+        infos.append(ni)
+    sb = SnapshotBuilder()
+    host = sb.build(infos)
+    cluster = host.to_device()
+    pb = PodBatchBuilder(sb.table)
+    batch = jax.tree.map(np.asarray,
+                         pb.build([PodInfo(p) for p in pending],
+                                  spread_selectors=spread_selectors))
+    cfg = programs.ProgramConfig(
+        filters=tuple(filters), scores=tuple(scores),
+        hostname_topokey=sb.table.topokey.get(api.LABEL_HOSTNAME))
+    res, chosen = programs.schedule_batch(cluster, batch, cfg,
+                                          jax.random.PRNGKey(seed))
+    return Result(res, chosen, len(nodes), len(pending), [n.name for n in nodes])

@@ -1,0 +1,367 @@
+"""Kernel golden tests: expectations derived from the reference plugins'
+documented algorithms and unit-test tables (values computed independently
+with integer arithmetic)."""
+import numpy as np
+import pytest
+
+from kubetpu.api import types as api
+from tests.harness import run_cluster
+from tests.test_tensors import mknode, mkpod
+
+
+def cpu_mem_pod(name, cpu, mem, **kw):
+    return mkpod(name, cpu=cpu, mem=mem, **kw)
+
+
+FIT_ONLY = ["NodeResourcesFit"]
+LEAST = [("NodeResourcesLeastAllocated", 1)]
+BALANCED = [("NodeResourcesBalancedAllocation", 1)]
+
+
+class TestFit:
+    def test_exact_fit_boundary(self):
+        nodes = [mknode("n1", cpu="1", mem="1Gi")]
+        existing = {"n1": [cpu_mem_pod("e1", "600m", "512Mi")]}
+        r = run_cluster(nodes, existing, [cpu_mem_pod("p", "400m", "512Mi")],
+                        filters=FIT_ONLY, scores=[])
+        assert r.feasible[0, 0]  # exactly fits
+        r = run_cluster(nodes, existing, [cpu_mem_pod("p", "401m", "512Mi")],
+                        filters=FIT_ONLY, scores=[])
+        assert not r.feasible[0, 0]
+
+    def test_pod_count(self):
+        nodes = [mknode("n1", pods="1")]
+        existing = {"n1": [cpu_mem_pod("e1", "1m", "1Mi")]}
+        r = run_cluster(nodes, existing, [cpu_mem_pod("p", "1m", "1Mi")],
+                        filters=FIT_ONLY, scores=[])
+        assert not r.feasible[0, 0]  # too many pods
+
+    def test_zero_request_always_fits(self):
+        nodes = [mknode("n1", cpu="1", mem="1Gi")]
+        # node already over-full on cpu
+        existing = {"n1": [cpu_mem_pod("e1", "2", "512Mi")]}
+        r = run_cluster(nodes, existing, [mkpod("p", cpu=None)],
+                        filters=FIT_ONLY, scores=[])
+        assert r.feasible[0, 0]
+
+    def test_extended_resource(self):
+        n = mknode("n1")
+        n.status.allocatable["example.com/gpu"] = "2"
+        nodes = [n]
+        gpu_pod = mkpod("p")
+        gpu_pod.spec.containers[0].resources.requests["example.com/gpu"] = "3"
+        r = run_cluster(nodes, {}, [gpu_pod], filters=FIT_ONLY, scores=[])
+        assert not r.feasible[0, 0]
+        gpu_pod2 = mkpod("p2")
+        gpu_pod2.spec.containers[0].resources.requests["example.com/gpu"] = "2"
+        r = run_cluster(nodes, {}, [gpu_pod2], filters=FIT_ONLY, scores=[])
+        assert r.feasible[0, 0]
+
+
+class TestResourceScores:
+    def test_least_allocated_formula(self):
+        # node: 4000m cpu, 10000Mi mem; existing 2500m/5000Mi; pod 1000m/2000Mi
+        # cpu: (4000-3500)*100/4000 = 12 (int div); mem: (10000-7000)*100/10000 = 30
+        # score = (12+30)/2 = 21
+        nodes = [mknode("n1", cpu="4", mem="10000Mi")]
+        existing = {"n1": [cpu_mem_pod("e", "2500m", "5000Mi")]}
+        r = run_cluster(nodes, existing, [cpu_mem_pod("p", "1", "2000Mi")],
+                        filters=FIT_ONLY, scores=LEAST)
+        assert r.scores[0, 0] == 21
+
+    def test_balanced_allocation_formula(self):
+        # cpu frac 3500/4000 = 0.875, mem frac 7000/10000 = 0.7
+        # score = floor((1-0.175)*100) = 82
+        nodes = [mknode("n1", cpu="4", mem="10000Mi")]
+        existing = {"n1": [cpu_mem_pod("e", "2500m", "5000Mi")]}
+        r = run_cluster(nodes, existing, [cpu_mem_pod("p", "1", "2000Mi")],
+                        filters=FIT_ONLY, scores=BALANCED)
+        assert r.scores[0, 0] == pytest.approx(82)
+
+    def test_balanced_overcommit_zero(self):
+        nodes = [mknode("n1", cpu="1", mem="10000Mi")]
+        r = run_cluster(nodes, {}, [cpu_mem_pod("p", "2", "100Mi")],
+                        filters=[], scores=BALANCED)
+        assert r.scores[0, 0] == 0
+
+    def test_nonzero_defaults_in_scoring(self):
+        # pod with no requests counts as 100m/200MB in Least/Balanced
+        # cpu: (1000-100)*100/1000 = 90; mem: (1000-200)*100/1000 = 80 -> 85
+        nodes = [mknode("n1", cpu="1", mem=str(1000 * 1024 * 1024))]
+        r = run_cluster(nodes, {}, [mkpod("p", cpu=None)],
+                        filters=FIT_ONLY, scores=LEAST)
+        assert r.scores[0, 0] == 85
+
+
+class TestNodeFilters:
+    def test_node_name(self):
+        nodes = [mknode("n1"), mknode("n2")]
+        r = run_cluster(nodes, {}, [mkpod("p", node_name="n2")],
+                        filters=["NodeName"], scores=[])
+        np.testing.assert_array_equal(r.feasible[0], [False, True])
+        assert r.unresolvable[0, 0]
+
+    def test_unschedulable(self):
+        nodes = [mknode("n1", unschedulable=True), mknode("n2")]
+        r = run_cluster(nodes, {}, [mkpod("p")],
+                        filters=["NodeUnschedulable"], scores=[])
+        np.testing.assert_array_equal(r.feasible[0], [False, True])
+        tol = api.Toleration(key="node.kubernetes.io/unschedulable",
+                             operator="Exists", effect="NoSchedule")
+        r = run_cluster(nodes, {}, [mkpod("p2", tolerations=[tol])],
+                        filters=["NodeUnschedulable"], scores=[])
+        np.testing.assert_array_equal(r.feasible[0], [True, True])
+
+    def test_taints(self):
+        t = api.Taint(key="k", value="v", effect="NoSchedule")
+        prefer = api.Taint(key="p", value="", effect="PreferNoSchedule")
+        nodes = [mknode("n1", taints=[t]), mknode("n2", taints=[prefer]), mknode("n3")]
+        r = run_cluster(nodes, {}, [mkpod("p")],
+                        filters=["TaintToleration"], scores=[])
+        np.testing.assert_array_equal(r.feasible[0], [False, True, True])
+        tol = api.Toleration(key="k", operator="Equal", value="v", effect="NoSchedule")
+        r = run_cluster(nodes, {}, [mkpod("p2", tolerations=[tol])],
+                        filters=["TaintToleration"], scores=[])
+        np.testing.assert_array_equal(r.feasible[0], [True, True, True])
+
+    def test_taint_score(self):
+        prefer = api.Taint(key="p", value="", effect="PreferNoSchedule")
+        nodes = [mknode("n1", taints=[prefer]), mknode("n2")]
+        r = run_cluster(nodes, {}, [mkpod("p")], filters=[],
+                        scores=[("TaintToleration", 1)])
+        # n1 has 1 intolerable prefer taint -> reverse-normalized: n1=0, n2=100
+        np.testing.assert_array_equal(r.scores[0], [0, 100])
+
+    def test_ports(self):
+        used = mkpod("e1")
+        used.spec.containers[0].ports = [api.ContainerPort(host_port=8080)]
+        nodes = [mknode("n1"), mknode("n2")]
+        want = mkpod("p")
+        want.spec.containers[0].ports = [api.ContainerPort(host_port=8080)]
+        r = run_cluster(nodes, {"n1": [used]}, [want],
+                        filters=["NodePorts"], scores=[])
+        np.testing.assert_array_equal(r.feasible[0], [False, True])
+
+    def test_ports_wildcard_semantics(self):
+        used = mkpod("e1")
+        used.spec.containers[0].ports = [
+            api.ContainerPort(host_port=8080, host_ip="1.2.3.4")]
+        nodes = [mknode("n1")]
+        # different specific ip, same port: no conflict
+        p = mkpod("p")
+        p.spec.containers[0].ports = [
+            api.ContainerPort(host_port=8080, host_ip="5.6.7.8")]
+        r = run_cluster(nodes, {"n1": [used]}, [p], filters=["NodePorts"], scores=[])
+        assert r.feasible[0, 0]
+        # wildcard ip, same port: conflict
+        p2 = mkpod("p2")
+        p2.spec.containers[0].ports = [api.ContainerPort(host_port=8080)]
+        r = run_cluster(nodes, {"n1": [used]}, [p2], filters=["NodePorts"], scores=[])
+        assert not r.feasible[0, 0]
+
+    def test_node_selector_and_affinity(self):
+        nodes = [mknode("n1", labels={"disk": "ssd"}), mknode("n2")]
+        r = run_cluster(nodes, {}, [mkpod("p", node_selector={"disk": "ssd"})],
+                        filters=["NodeAffinity"], scores=[])
+        np.testing.assert_array_equal(r.feasible[0], [True, False])
+        aff = api.Affinity(node_affinity=api.NodeAffinity(
+            required_during_scheduling_ignored_during_execution=api.NodeSelector([
+                api.NodeSelectorTerm(match_expressions=[
+                    api.NodeSelectorRequirement("disk", "In", ["ssd", "nvme"])])])))
+        r = run_cluster(nodes, {}, [mkpod("p2", affinity=aff)],
+                        filters=["NodeAffinity"], scores=[])
+        np.testing.assert_array_equal(r.feasible[0], [True, False])
+
+    def test_preferred_node_affinity_score(self):
+        nodes = [mknode("n1", labels={"disk": "ssd"}), mknode("n2")]
+        aff = api.Affinity(node_affinity=api.NodeAffinity(
+            preferred_during_scheduling_ignored_during_execution=[
+                api.PreferredSchedulingTerm(weight=80, preference=api.NodeSelectorTerm(
+                    match_expressions=[api.NodeSelectorRequirement("disk", "In", ["ssd"])]))]))
+        r = run_cluster(nodes, {}, [mkpod("p", affinity=aff)],
+                        filters=[], scores=[("NodeAffinity", 1)])
+        np.testing.assert_array_equal(r.scores[0], [100, 0])
+
+
+class TestSpread:
+    def zone_nodes(self):
+        return [mknode("a1", labels={api.LABEL_ZONE: "zoneA", api.LABEL_HOSTNAME: "a1"}),
+                mknode("a2", labels={api.LABEL_ZONE: "zoneA", api.LABEL_HOSTNAME: "a2"}),
+                mknode("b1", labels={api.LABEL_ZONE: "zoneB", api.LABEL_HOSTNAME: "b1"})]
+
+    def spread_pod(self, name, max_skew=1, key=api.LABEL_ZONE, labels=None):
+        return mkpod(name, labels=labels or {"app": "web"},
+                     topology_spread_constraints=[api.TopologySpreadConstraint(
+                         max_skew=max_skew, topology_key=key,
+                         when_unsatisfiable="DoNotSchedule",
+                         label_selector=api.LabelSelector(match_labels={"app": "web"}))])
+
+    def test_hard_spread_filter(self):
+        nodes = self.zone_nodes()
+        # zoneA has 2 matching pods, zoneB has 0 -> skew: placing in A = 3-0 > 1
+        existing = {"a1": [mkpod("e1", labels={"app": "web"})],
+                    "a2": [mkpod("e2", labels={"app": "web"})]}
+        r = run_cluster(nodes, existing, [self.spread_pod("p")],
+                        filters=["PodTopologySpread"], scores=[])
+        np.testing.assert_array_equal(r.feasible[0], [False, False, True])
+
+    def test_hard_spread_satisfiable(self):
+        nodes = self.zone_nodes()
+        existing = {"a1": [mkpod("e1", labels={"app": "web"})]}
+        # zoneA=1, zoneB=0; placing in A: 2-0=2 > 1 fail; B: 1-1=0 ok... wait
+        # minMatch with B=0: A->1+1-0=2>1 fail, B->0+1-0=1<=1 ok
+        r = run_cluster(nodes, existing, [self.spread_pod("p")],
+                        filters=["PodTopologySpread"], scores=[])
+        np.testing.assert_array_equal(r.feasible[0], [False, False, True])
+
+    def test_spread_missing_key_fails(self):
+        nodes = self.zone_nodes() + [mknode("c1", labels={api.LABEL_HOSTNAME: "c1"})]
+        r = run_cluster(nodes, {}, [self.spread_pod("p")],
+                        filters=["PodTopologySpread"], scores=[])
+        # c1 lacks the zone label -> fails constraint
+        np.testing.assert_array_equal(r.feasible[0], [True, True, True, False])
+
+    def test_nonmatching_selector_pod_ignored(self):
+        nodes = self.zone_nodes()
+        existing = {"a1": [mkpod("e1", labels={"app": "other"})] * 3}
+        r = run_cluster(nodes, existing, [self.spread_pod("p")],
+                        filters=["PodTopologySpread"], scores=[])
+        np.testing.assert_array_equal(r.feasible[0], [True, True, True])
+
+    def test_soft_spread_score_prefers_low_count_zone(self):
+        nodes = self.zone_nodes()
+        existing = {"a1": [mkpod("e1", labels={"app": "web"})],
+                    "a2": [mkpod("e2", labels={"app": "web"})]}
+        pod = mkpod("p", labels={"app": "web"},
+                    topology_spread_constraints=[api.TopologySpreadConstraint(
+                        max_skew=1, topology_key=api.LABEL_ZONE,
+                        when_unsatisfiable="ScheduleAnyway",
+                        label_selector=api.LabelSelector(match_labels={"app": "web"}))])
+        r = run_cluster(nodes, existing, [pod], filters=[],
+                        scores=[("PodTopologySpread", 2)])
+        s = r.scores[0]
+        assert s[2] > s[0] and s[2] > s[1]
+
+
+class TestInterPodAffinity:
+    def zone_nodes(self):
+        return [mknode("a1", labels={api.LABEL_ZONE: "zoneA"}),
+                mknode("b1", labels={api.LABEL_ZONE: "zoneB"})]
+
+    def affinity_pod(self, name, anti=False, labels=None, sel=None,
+                     key=api.LABEL_ZONE):
+        term = api.PodAffinityTerm(
+            label_selector=api.LabelSelector(match_labels=sel or {"app": "db"}),
+            topology_key=key)
+        if anti:
+            aff = api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
+                required_during_scheduling_ignored_during_execution=[term]))
+        else:
+            aff = api.Affinity(pod_affinity=api.PodAffinity(
+                required_during_scheduling_ignored_during_execution=[term]))
+        return mkpod(name, labels=labels or {}, affinity=aff)
+
+    def test_required_affinity(self):
+        nodes = self.zone_nodes()
+        existing = {"a1": [mkpod("db", labels={"app": "db"})]}
+        r = run_cluster(nodes, existing, [self.affinity_pod("p")],
+                        filters=["InterPodAffinity"], scores=[])
+        np.testing.assert_array_equal(r.feasible[0], [True, False])
+        assert r.unresolvable[0, 1]  # affinity failure is unresolvable
+
+    def test_required_affinity_no_match_anywhere(self):
+        nodes = self.zone_nodes()
+        r = run_cluster(nodes, {}, [self.affinity_pod("p")],
+                        filters=["InterPodAffinity"], scores=[])
+        np.testing.assert_array_equal(r.feasible[0], [False, False])
+
+    def test_bootstrap_self_match(self):
+        # pod matches its own affinity term -> schedulable anywhere with the key
+        nodes = self.zone_nodes()
+        r = run_cluster(nodes, {},
+                        [self.affinity_pod("p", labels={"app": "db"})],
+                        filters=["InterPodAffinity"], scores=[])
+        np.testing.assert_array_equal(r.feasible[0], [True, True])
+
+    def test_required_anti_affinity(self):
+        nodes = self.zone_nodes()
+        existing = {"a1": [mkpod("db", labels={"app": "db"})]}
+        r = run_cluster(nodes, existing, [self.affinity_pod("p", anti=True)],
+                        filters=["InterPodAffinity"], scores=[])
+        np.testing.assert_array_equal(r.feasible[0], [False, True])
+
+    def test_existing_pod_anti_affinity(self):
+        # existing pod repels incoming pods labeled app=web zone-wide
+        nodes = self.zone_nodes()
+        repeller = self.affinity_pod("r", anti=True, sel={"app": "web"})
+        existing = {"a1": [repeller]}
+        r = run_cluster(nodes, existing, [mkpod("p", labels={"app": "web"})],
+                        filters=["InterPodAffinity"], scores=[])
+        np.testing.assert_array_equal(r.feasible[0], [False, True])
+        r = run_cluster(nodes, existing, [mkpod("p2", labels={"app": "other"})],
+                        filters=["InterPodAffinity"], scores=[])
+        np.testing.assert_array_equal(r.feasible[0], [True, True])
+
+    def test_preferred_affinity_score(self):
+        nodes = self.zone_nodes()
+        existing = {"a1": [mkpod("db", labels={"app": "db"})]}
+        term = api.WeightedPodAffinityTerm(weight=50, pod_affinity_term=api.PodAffinityTerm(
+            label_selector=api.LabelSelector(match_labels={"app": "db"}),
+            topology_key=api.LABEL_ZONE))
+        pod = mkpod("p", affinity=api.Affinity(pod_affinity=api.PodAffinity(
+            preferred_during_scheduling_ignored_during_execution=[term])))
+        r = run_cluster(nodes, existing, [pod], filters=[],
+                        scores=[("InterPodAffinity", 1)])
+        np.testing.assert_array_equal(r.scores[0], [100, 0])
+
+
+class TestOtherScores:
+    def test_image_locality(self):
+        n1 = mknode("n1")
+        n1.status.images = [api.ContainerImage(names=["img:1"], size_bytes=270 * 1024 * 1024)]
+        nodes = [n1, mknode("n2")]
+        r = run_cluster(nodes, {}, [mkpod("p")], filters=[],
+                        scores=[("ImageLocality", 1)])
+        # scaled = 270MB * (1/2 nodes) = 135MB; (135-23)/(1000-23)*100 = 11
+        assert r.scores[0, 0] == pytest.approx(11)
+        assert r.scores[0, 1] == 0
+
+    def test_prefer_avoid(self):
+        import json
+        n1 = mknode("n1")
+        n1.metadata.annotations[api.PREFER_AVOID_PODS_ANNOTATION_KEY] = json.dumps({
+            "preferAvoidPods": [{"podSignature": {"podController": {
+                "kind": "ReplicaSet", "uid": "rs-1"}}}]})
+        nodes = [n1, mknode("n2")]
+        pod = mkpod("p")
+        pod.metadata.owner_references = [api.OwnerReference(
+            kind="ReplicaSet", uid="rs-1", controller=True)]
+        r = run_cluster(nodes, {}, [pod], filters=[],
+                        scores=[("NodePreferAvoidPods", 1)])
+        np.testing.assert_array_equal(r.scores[0], [0, 100])
+        free = mkpod("free")
+        r = run_cluster(nodes, {}, [free], filters=[],
+                        scores=[("NodePreferAvoidPods", 1)])
+        np.testing.assert_array_equal(r.scores[0], [100, 100])
+
+    def test_default_spread(self):
+        nodes = [mknode("n1", labels={api.LABEL_ZONE_LEGACY: "zA"}),
+                 mknode("n2", labels={api.LABEL_ZONE_LEGACY: "zB"})]
+        existing = {"n1": [mkpod("e1", labels={"app": "svc"})]}
+        sel = api.LabelSelector(match_labels={"app": "svc"})
+        r = run_cluster(nodes, existing, [mkpod("p", labels={"app": "svc"})],
+                        filters=[], scores=[("DefaultPodTopologySpread", 1)],
+                        spread_selectors=[sel])
+        # n1 hosts 1 matching pod; zone A count 1; n2: 0/0
+        # node score n1: 100*(1-1)/1=0; zone n1: 100*(1-1)/1=0 -> 0
+        # n2: node 100, zone 100 -> 100
+        np.testing.assert_array_equal(r.scores[0], [0, 100])
+
+
+class TestSelect:
+    def test_picks_max_and_breaks_ties(self):
+        nodes = [mknode("n1", cpu="4"), mknode("n2", cpu="8"), mknode("n3", cpu="8")]
+        r = run_cluster(nodes, {}, [cpu_mem_pod("p", "1", "1Gi")],
+                        filters=FIT_ONLY, scores=LEAST)
+        assert r.chosen[0] in (1, 2)
+        assert r.scores[0, 1] == r.scores[0, 2] > r.scores[0, 0]
