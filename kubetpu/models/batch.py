@@ -58,7 +58,9 @@ class PodBatch(NamedTuple):
     ns_hot: np.ndarray         # [B, NS] f32 one-hot namespace
     node_name_kvid: np.ndarray  # [B] i32 kv id of (__field__metadata.name, spec.nodeName); -1 unset
     has_node_name: np.ndarray  # [B] bool
-    ports_hot: np.ndarray      # [B, P] f32
+    ports_hot: np.ndarray      # [B, P] f32 — ids the pod *probes* for conflicts
+    ports_asnode_hot: np.ndarray  # [B, P] f32 — ids the pod *registers* once
+                               # placed (for intra-batch conflicts in the scan)
     tolerated: np.ndarray      # [B, T] bool over taint vocab
     priority: np.ndarray       # [B] i32
     images_hot: np.ndarray     # [B, I] f32 — container images (non-init)
@@ -117,6 +119,7 @@ class PodBatchBuilder:
         node_name_kvid = np.full((B,), -1, np.int32)
         has_node_name = np.zeros((B,), bool)
         ports_hot = np.zeros((B, P), np.float32)
+        ports_asnode_hot = np.zeros((B, P), np.float32)
         tolerated = np.zeros((B, T), bool)
         priority = np.zeros((B,), np.int32)
         images_hot = np.zeros((B, I), np.float32)
@@ -162,6 +165,11 @@ class PodBatchBuilder:
                         j = t.port.get(pid)
                         if j >= 0:
                             ports_hot[i, j] = 1.0
+                    from ..state.tensors import _port_ids_node
+                    for pid in _port_ids_node(triple):
+                        j = t.port.get(pid)
+                        if j >= 0:
+                            ports_asnode_hot[i, j] = 1.0
                 if c.image:
                     j = t.image.get(_norm_image(c.image))
                     if j >= 0:
@@ -257,6 +265,7 @@ class PodBatchBuilder:
         return PodBatch(req=req, nonzero_req=nonzero, limits=limits, kv_hot=kv_hot,
                         key_hot=key_hot, ns_hot=ns_hot, node_name_kvid=node_name_kvid,
                         has_node_name=has_node_name, ports_hot=ports_hot,
+                        ports_asnode_hot=ports_asnode_hot,
                         tolerated=tolerated, priority=priority, images_hot=images_hot,
                         n_containers=n_containers, avoid_id=avoid_id,
                         tolerates_unschedulable=tolerates_unschedulable,

@@ -186,6 +186,34 @@ class SnapshotBuilder:
                     for ns in term.namespaces:
                         t.ns.intern(ns)
 
+    def intern_pending(self, pods: List[PodInfo]) -> None:
+        """Pre-intern the strings of *pending* pods so vocab capacities are
+        final before snapshot arrays are sized.  Without this, two batch pods
+        sharing a label or hostPort that exists nowhere else in the cluster
+        could not see each other in the intra-batch (scan) interactions."""
+        t = self.table
+        for pi in pods:
+            p = pi.pod
+            t.ns.intern(p.namespace)
+            for k, v in p.metadata.labels.items():
+                t.kv.intern((k, v)); t.key.intern(k)
+            for c in p.spec.containers:
+                for port in c.ports:
+                    if port.host_port <= 0:
+                        continue
+                    triple = (port.protocol or "TCP", port.host_ip or "0.0.0.0",
+                              port.host_port)
+                    for pid in _port_ids_node(triple) + port_ids_pod(triple):
+                        t.port.intern(pid)
+            for term in (pi.required_affinity_terms + pi.required_anti_affinity_terms
+                         + [w.term for w in pi.preferred_affinity_terms]
+                         + [w.term for w in pi.preferred_anti_affinity_terms]):
+                t.topokey.intern(term.topology_key)
+                for ns in term.namespaces:
+                    t.ns.intern(ns)
+            for c in p.spec.topology_spread_constraints:
+                t.topokey.intern(c.topology_key)
+
     # -- build --------------------------------------------------------------
 
     def build(self, nodes: List[NodeInfo]) -> HostClusterArrays:

@@ -39,12 +39,13 @@ def run_cluster(nodes: List[api.Node],
             ni.add_pod(p)
         infos.append(ni)
     sb = SnapshotBuilder()
+    pinfos = [PodInfo(p) for p in pending]
+    sb.intern_pending(pinfos)
     host = sb.build(infos)
     cluster = host.to_device()
     pb = PodBatchBuilder(sb.table)
     batch = jax.tree.map(np.asarray,
-                         pb.build([PodInfo(p) for p in pending],
-                                  spread_selectors=spread_selectors))
+                         pb.build(pinfos, spread_selectors=spread_selectors))
     cfg = programs.ProgramConfig(
         filters=tuple(filters), scores=tuple(scores),
         hostname_topokey=sb.table.topokey.get(api.LABEL_HOSTNAME))

@@ -1,0 +1,210 @@
+"""Sequential scan program tests: intra-batch interactions must match the
+reference's serial one-pod-at-a-time semantics.  The differential test
+replays the same workload with B=1 batches and a fresh snapshot per pod (the
+trivially-correct serial mode) and compares placements."""
+from typing import Dict, List
+
+import jax
+import numpy as np
+
+from kubetpu.api import types as api
+from kubetpu.framework.types import NodeInfo, PodInfo
+from kubetpu.models import programs, sequential
+from kubetpu.models.batch import PodBatchBuilder
+from kubetpu.state.tensors import SnapshotBuilder
+from tests.test_tensors import mknode, mkpod
+
+
+def run_seq(nodes: List[api.Node], existing: Dict[str, List[api.Pod]],
+            pending: List[api.Pod],
+            filters=programs.DEFAULT_FILTER_PLUGINS,
+            scores=programs.DEFAULT_SCORE_PLUGINS, seed=0):
+    infos = []
+    for n in nodes:
+        ni = NodeInfo(n)
+        for p in existing.get(n.name, []):
+            p.spec.node_name = n.name
+            ni.add_pod(p)
+        infos.append(ni)
+    sb = SnapshotBuilder()
+    pinfos = [PodInfo(p) for p in pending]
+    sb.intern_pending(pinfos)
+    cluster = sb.build(infos).to_device()
+    pb = PodBatchBuilder(sb.table)
+    batch = jax.tree.map(np.asarray, pb.build(pinfos))
+    cfg = programs.ProgramConfig(
+        filters=tuple(filters), scores=tuple(scores),
+        hostname_topokey=sb.table.topokey.get(api.LABEL_HOSTNAME))
+    res = sequential.schedule_sequential(cluster, batch, cfg,
+                                         jax.random.PRNGKey(seed))
+    return res, [n.name for n in nodes]
+
+
+def serial_replay(nodes: List[api.Node], existing: Dict[str, List[api.Pod]],
+                  pending: List[api.Pod], filters, scores, seed=0):
+    """Reference semantics: one pod at a time, snapshot rebuilt in between."""
+    placements = {n.name: list(existing.get(n.name, [])) for n in nodes}
+    chosen_names = []
+    for idx, pod in enumerate(pending):
+        res, _ = _run_one(nodes, placements, pod, filters, scores, seed)
+        feas = np.asarray(res.feasible)[0, :len(nodes)]
+        scoresv = np.asarray(res.scores)[0, :len(nodes)]
+        if not feas.any():
+            chosen_names.append(None)
+            continue
+        best = scoresv[feas].max()
+        ties = [i for i in range(len(nodes)) if feas[i] and scoresv[i] == best]
+        pick = ties[0]  # deterministic comparison uses unique-score workloads
+        chosen_names.append(nodes[pick].name)
+        placed = _clone_pod(pod)
+        placements[nodes[pick].name].append(placed)
+    return chosen_names
+
+
+def _clone_pod(pod):
+    import copy
+    return copy.deepcopy(pod)
+
+
+def _run_one(nodes, placements, pod, filters, scores, seed):
+    infos = []
+    for n in nodes:
+        ni = NodeInfo(n)
+        for p in placements[n.name]:
+            p.spec.node_name = n.name
+            ni.add_pod(p)
+        infos.append(ni)
+    sb = SnapshotBuilder()
+    pinfos = [PodInfo(pod)]
+    sb.intern_pending(pinfos)
+    cluster = sb.build(infos).to_device()
+    pb = PodBatchBuilder(sb.table)
+    batch = jax.tree.map(np.asarray, pb.build(pinfos))
+    cfg = programs.ProgramConfig(
+        filters=tuple(filters), scores=tuple(scores),
+        hostname_topokey=sb.table.topokey.get(api.LABEL_HOSTNAME))
+    return programs.schedule_batch(cluster, batch, cfg, jax.random.PRNGKey(seed))
+
+
+class TestCapacityInteraction:
+    def test_fills_then_unschedulable(self):
+        nodes = [mknode("n1", cpu="1", mem="1Gi", pods="10"),
+                 mknode("n2", cpu="1", mem="1Gi", pods="10")]
+        pods = [mkpod(f"p{i}", cpu="800m", mem="100Mi") for i in range(3)]
+        res, names = run_seq(nodes, {}, pods,
+                             filters=["NodeResourcesFit"],
+                             scores=[("NodeResourcesLeastAllocated", 1)])
+        c = np.asarray(res.chosen)[:3]
+        assert set(c[:2]) == {0, 1}  # spread over both empty nodes
+        assert c[2] == -1            # no capacity left
+        assert np.asarray(res.n_feasible)[2] == 0
+
+    def test_pod_count_capacity(self):
+        nodes = [mknode("n1", pods="2")]
+        pods = [mkpod(f"p{i}", cpu="1m", mem="1Mi") for i in range(3)]
+        res, _ = run_seq(nodes, {}, pods, filters=["NodeResourcesFit"], scores=[])
+        c = np.asarray(res.chosen)[:3]
+        assert list(c) == [0, 0, -1]
+
+
+class TestSpreadInteraction:
+    def test_hard_spread_across_zones(self):
+        nodes = [mknode(f"n{z}", labels={api.LABEL_ZONE: f"z{z}",
+                                         api.LABEL_HOSTNAME: f"n{z}"})
+                 for z in range(3)]
+        cons = api.TopologySpreadConstraint(
+            max_skew=1, topology_key=api.LABEL_ZONE,
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=api.LabelSelector(match_labels={"app": "w"}))
+        pods = [mkpod(f"p{i}", labels={"app": "w"},
+                      topology_spread_constraints=[cons]) for i in range(4)]
+        res, _ = run_seq(nodes, {}, pods,
+                         filters=["NodeResourcesFit", "PodTopologySpread"],
+                         scores=[])
+        c = np.asarray(res.chosen)[:4]
+        # first three pods must land in three distinct zones (skew 1)
+        assert set(c[:3]) == {0, 1, 2}
+        assert c[3] in (0, 1, 2)
+
+    def test_anti_affinity_intra_batch(self):
+        nodes = [mknode(f"n{z}", labels={api.LABEL_ZONE: f"z{z}"}) for z in range(2)]
+        term = api.PodAffinityTerm(
+            label_selector=api.LabelSelector(match_labels={"app": "w"}),
+            topology_key=api.LABEL_ZONE)
+        aff = api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
+            required_during_scheduling_ignored_during_execution=[term]))
+        pods = [mkpod(f"p{i}", labels={"app": "w"}, affinity=aff) for i in range(3)]
+        res, _ = run_seq(nodes, {}, pods,
+                         filters=["NodeResourcesFit", "InterPodAffinity"],
+                         scores=[])
+        c = np.asarray(res.chosen)[:3]
+        assert set(c[:2]) == {0, 1}  # repel each other across zones
+        assert c[2] == -1            # nowhere left
+
+    def test_affinity_intra_batch_bootstrap_then_colocate(self):
+        nodes = [mknode(f"n{z}", labels={api.LABEL_ZONE: f"z{z}"}) for z in range(2)]
+        term = api.PodAffinityTerm(
+            label_selector=api.LabelSelector(match_labels={"app": "w"}),
+            topology_key=api.LABEL_ZONE)
+        aff = api.Affinity(pod_affinity=api.PodAffinity(
+            required_during_scheduling_ignored_during_execution=[term]))
+        pods = [mkpod(f"p{i}", labels={"app": "w"}, affinity=aff) for i in range(3)]
+        res, _ = run_seq(nodes, {}, pods,
+                         filters=["NodeResourcesFit", "InterPodAffinity"],
+                         scores=[])
+        c = np.asarray(res.chosen)[:3]
+        assert c[0] in (0, 1)       # bootstrap rule
+        assert c[1] == c[0] and c[2] == c[0]  # then co-locate
+
+
+class TestPortsInteraction:
+    def test_host_port_conflict_intra_batch(self):
+        nodes = [mknode("n1"), mknode("n2")]
+        pods = []
+        for i in range(3):
+            p = mkpod(f"p{i}")
+            p.spec.containers[0].ports = [api.ContainerPort(host_port=8080)]
+            pods.append(p)
+        res, _ = run_seq(nodes, {}, pods,
+                         filters=["NodeResourcesFit", "NodePorts"], scores=[])
+        c = np.asarray(res.chosen)[:3]
+        assert set(c[:2]) == {0, 1}
+        assert c[2] == -1
+
+
+class TestDifferentialVsSerial:
+    def test_mixed_workload_matches_serial_replay(self):
+        # unique capacities -> unique scores -> deterministic placement
+        nodes = [mknode(f"n{i}", cpu=str(2 + i), mem=f"{4 + i}Gi",
+                        labels={api.LABEL_ZONE: f"z{i % 2}",
+                                api.LABEL_HOSTNAME: f"n{i}"})
+                 for i in range(4)]
+        existing = {"n0": [mkpod("e0", cpu="500m", mem="1Gi",
+                                 labels={"app": "db"})]}
+        cons = api.TopologySpreadConstraint(
+            max_skew=2, topology_key=api.LABEL_ZONE,
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=api.LabelSelector(match_labels={"app": "w"}))
+        pods = []
+        for i in range(6):
+            if i % 3 == 0:
+                pods.append(mkpod(f"p{i}", cpu="700m", mem="1Gi",
+                                  labels={"app": "w"},
+                                  topology_spread_constraints=[cons]))
+            elif i % 3 == 1:
+                term = api.PodAffinityTerm(
+                    label_selector=api.LabelSelector(match_labels={"app": "db"}),
+                    topology_key=api.LABEL_ZONE)
+                aff = api.Affinity(pod_affinity=api.PodAffinity(
+                    required_during_scheduling_ignored_during_execution=[term]))
+                pods.append(mkpod(f"p{i}", cpu="300m", mem="512Mi", affinity=aff))
+            else:
+                pods.append(mkpod(f"p{i}", cpu="1", mem="2Gi"))
+        filters = programs.DEFAULT_FILTER_PLUGINS
+        scores = programs.DEFAULT_SCORE_PLUGINS
+        want = serial_replay(nodes, existing, [_clone_pod(p) for p in pods],
+                             filters, scores)
+        res, names = run_seq(nodes, existing, pods, filters, scores)
+        got = [names[c] if c >= 0 else None
+               for c in np.asarray(res.chosen)[:len(pods)]]
+        assert got == want
