@@ -1,0 +1,102 @@
+"""Headline benchmark: sequential-replay scheduling throughput.
+
+Schedules PODS pending pods against NODES nodes with the full default
+plugin matrix (reference: pkg/scheduler/algorithmprovider/registry.go:77-160)
+in the sequential-replay scan — the mode whose semantics match the
+reference's serial scheduleOne loop (pkg/scheduler/scheduler.go:509), so the
+pods/s number is comparable to the reference's scheduler_perf density floor
+of 30 pods/s (reference: test/integration/scheduler_perf/scheduler_test.go:
+40-41,81-87 — hard-fails below 30, warns below 100).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    n_nodes = int(os.environ.get("BENCH_NODES", "1000"))
+    n_pods = int(os.environ.get("BENCH_PODS", "4096"))
+    existing_per_node = int(os.environ.get("BENCH_EXISTING_PER_NODE", "2"))
+    repeats = int(os.environ.get("BENCH_REPEATS", "3"))
+
+    import jax
+
+    from kubetpu.api import types as api
+    from kubetpu.framework.types import NodeInfo, PodInfo
+    from kubetpu.harness import hollow
+    from kubetpu.models import programs
+    from kubetpu.models.batch import PodBatchBuilder
+    from kubetpu.models.sequential import schedule_sequential
+    from kubetpu.state.tensors import SnapshotBuilder
+
+    t0 = time.time()
+    nodes = hollow.make_nodes(n_nodes, zones=8)
+    infos = []
+    for i, n in enumerate(nodes):
+        ni = NodeInfo(n)
+        for p in hollow.make_pods(existing_per_node, prefix=f"ex-{i}-",
+                                  group_labels=16):
+            p.spec.node_name = n.name
+            ni.add_pod(p)
+        infos.append(ni)
+
+    pending = hollow.make_pods(n_pods, prefix="pend-", group_labels=16)
+    # topology work mixed in like scheduler_perf's blended configs:
+    # 1/3 soft zone spread, 1/5 hostname anti-affinity on the app group
+    for i, p in enumerate(pending):
+        if i % 3 == 0:
+            hollow.with_spread(p, api.LABEL_ZONE, when="ScheduleAnyway")
+        if i % 5 == 0:
+            hollow.with_anti_affinity(p, api.LABEL_HOSTNAME)
+
+    sb = SnapshotBuilder()
+    pinfos = [PodInfo(p) for p in pending]
+    sb.intern_pending(pinfos)
+    cluster = sb.build(infos).to_device()
+    batch = jax.tree.map(np.asarray, PodBatchBuilder(sb.table).build(pinfos))
+    cfg = programs.ProgramConfig(
+        hostname_topokey=max(sb.table.topokey.get(api.LABEL_HOSTNAME), 0))
+    rng = jax.random.PRNGKey(0)
+    build_s = time.time() - t0
+
+    # warmup / compile
+    t0 = time.time()
+    res = schedule_sequential(cluster, batch, cfg, rng)
+    jax.block_until_ready(res.chosen)
+    compile_s = time.time() - t0
+
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.time()
+        res = schedule_sequential(cluster, batch, cfg, rng)
+        jax.block_until_ready(res.chosen)
+        best = min(best, time.time() - t0)
+
+    scheduled = int(np.sum(np.asarray(res.chosen)[: len(pending)] >= 0))
+    pods_per_sec = len(pending) / best
+    baseline = 30.0  # reference hard throughput floor (scheduler_test.go:40)
+    print(json.dumps({
+        "metric": f"seq_schedule_throughput_{n_pods}pods_{n_nodes}nodes",
+        "value": round(pods_per_sec, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(pods_per_sec / baseline, 2),
+    }))
+    print(json.dumps({
+        "detail": {"scheduled": scheduled, "pending": len(pending),
+                   "device_best_s": round(best, 4),
+                   "compile_s": round(compile_s, 1),
+                   "host_build_s": round(build_s, 1),
+                   "backend": jax.default_backend()},
+    }), file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

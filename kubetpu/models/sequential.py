@@ -95,9 +95,6 @@ def schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
     apply_static("TaintToleration", K.taint_filter(cluster, batch))
 
     ports_ok0 = K.node_ports_filter(cluster, batch) if "NodePorts" in filters else None
-    portc_bb = (jnp.einsum("bp,ip->bi", batch.ports_hot, batch.ports_asnode_hot,
-                           preferred_element_type=jnp.float32) > 0.5
-                if "NodePorts" in filters else None)
 
     ns_eq = jnp.einsum("bn,in->bi", batch.ns_hot, batch.ns_hot,
                        preferred_element_type=jnp.float32) > 0.5  # [B, B]
@@ -251,7 +248,10 @@ def schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
         "nz": cluster.nonzero_requested,
     }
     if ports_ok0 is not None:
-        carry0["port_block"] = jnp.zeros((B, N), bool)
+        # ports the scan's own placements have registered per node; existing
+        # pods' ports are already inside ports_ok0 via cluster.ports
+        carry0["ports_used"] = jnp.zeros((N, batch.ports_hot.shape[1]),
+                                         jnp.float32)
     if use_sph:
         carry0["sph_cnt"] = sph["st"].pair_counts
     if use_sps:
@@ -288,7 +288,8 @@ def schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
             feas = feas & pods_ok & (zero_req | res_ok)
 
         if ports_ok0 is not None:
-            feas = feas & ports_ok0[i] & ~carry["port_block"][i]
+            conflict = carry["ports_used"] @ batch.ports_hot[i] > 0.5  # [N]
+            feas = feas & ports_ok0[i] & ~conflict
 
         if use_sph:
             C = sph["C"]
@@ -461,8 +462,8 @@ def schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
         new["req"] = carry["req"].at[node].add(batch.req[i] * w)
         new["nz"] = carry["nz"].at[node].add(batch.nonzero_req[i] * w)
         if ports_ok0 is not None:
-            new["port_block"] = carry["port_block"].at[:, node].max(
-                portc_bb[:, i] & ok)
+            new["ports_used"] = carry["ports_used"].at[node].max(
+                batch.ports_asnode_hot[i] * w)
         if use_sph:
             ids = sph["st"].node_pair[:, node]  # [BC]
             vals = sph["m_bb"][:, i] * w * _f(ids >= 0)

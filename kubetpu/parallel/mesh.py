@@ -1,0 +1,127 @@
+"""Device-mesh sharding of the scheduling program.
+
+The reference scales one scheduling cycle with 16 chunked goroutines over the
+node list (reference: pkg/scheduler/internal/parallelize/parallelism.go:26-43,
+used from core/generic_scheduler.go:485 and framework.go:592).  The
+TPU-native equivalent shards the dense tensors over a
+`jax.sharding.Mesh` and lets XLA's SPMD partitioner insert the collectives
+the goroutine fan-in/atomic-counter code did by hand:
+
+  axis "pods"  — data parallelism over the pending-pod batch axis B (the
+                 analog of running many scheduleOne loops at once) and over
+                 the existing-pods axis P of the snapshot.
+  axis "nodes" — the node axis N of every per-node array (the analog of the
+                 16-goroutine chunking; also our "sequence parallelism" —
+                 SURVEY.md §5: the reference's long axis IS node count).
+
+Per-plugin NormalizeScore needs per-pod min/max over all nodes
+(framework.go:613); under this sharding XLA lowers that to an all-reduce
+over the "nodes" axis — the collective that replaces the serial
+NormalizeScore loop.  Pair/topology segment-sums over sharded pod or node
+axes become scatter-adds + psum.  Host code never writes collectives
+explicitly; shardings are the whole parallel API, per the scaling-book
+recipe (mesh -> annotate -> let XLA insert collectives).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..models import programs, sequential
+from ..state.tensors import ClusterTensors
+
+AXIS_PODS = "pods"
+AXIS_NODES = "nodes"
+
+# ClusterTensors fields whose leading axis is the node axis N.
+NODE_AXIS_FIELDS = frozenset({
+    "allocatable", "requested", "nonzero_requested", "node_valid",
+    "unschedulable", "kv", "keymask", "num", "topo_pair", "taints", "ports",
+    "images", "avoid_hot", "zone_id",
+})
+# ClusterTensors fields whose leading axis is the existing-pods axis P.
+POD_AXIS_FIELDS = frozenset({
+    "pod_kv", "pod_key", "pod_ns_hot", "pod_node", "pod_valid",
+    "pod_terminating",
+})
+
+
+def make_mesh(shape: Optional[Tuple[int, int]] = None,
+              devices=None) -> Mesh:
+    """Build a ("pods", "nodes") mesh.  Default shape puts all devices on
+    the node axis (the reference's only intra-cycle parallel axis)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if shape is None:
+        shape = (1, n)
+    if shape[0] * shape[1] != n:
+        raise ValueError(f"mesh shape {shape} != {n} devices")
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, (AXIS_PODS, AXIS_NODES))
+
+
+def shard_cluster(cluster: ClusterTensors, mesh: Mesh,
+                  shard_existing_pods: bool = True) -> ClusterTensors:
+    """device_put a host/replicated ClusterTensors onto the mesh."""
+    out = {}
+    for field in ClusterTensors._fields:
+        val = getattr(cluster, field)
+        if field in NODE_AXIS_FIELDS:
+            spec = P(AXIS_NODES)
+            out[field] = jax.device_put(val, NamedSharding(mesh, spec))
+        elif field in POD_AXIS_FIELDS and shard_existing_pods:
+            out[field] = jax.device_put(val, NamedSharding(mesh, P(AXIS_PODS)))
+        else:
+            out[field] = jax.tree.map(
+                lambda x: jax.device_put(x, NamedSharding(mesh, P())), val)
+    return ClusterTensors(**out)
+
+
+def shard_batch(batch, mesh: Mesh):
+    """Shard every PodBatch leaf on dim 0 over the "pods" axis.  All batch
+    leaves lead with B or a flattened B*T axis, so dim-0 sharding is the
+    data-parallel split of the pending-pod batch."""
+    n = mesh.shape[AXIS_PODS]
+
+    def put(x):
+        x = np.asarray(x)
+        if x.ndim >= 1 and x.shape[0] % n == 0:
+            return jax.device_put(x, NamedSharding(mesh, P(AXIS_PODS)))
+        return jax.device_put(x, NamedSharding(mesh, P()))
+    return jax.tree.map(put, batch)
+
+
+def replicate(tree, mesh: Mesh):
+    return jax.tree.map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, P())), tree)
+
+
+def sharded_schedule_batch(cluster, batch, cfg: programs.ProgramConfig, rng,
+                           mesh: Mesh, shard_existing_pods: bool = True):
+    """One-shot batch scheduling over the mesh.  Inputs are placed with
+    shard_cluster/shard_batch; jit consumes the committed shardings and the
+    SPMD partitioner derives every intermediate sharding + collective."""
+    cluster = shard_cluster(cluster, mesh, shard_existing_pods)
+    batch = shard_batch(batch, mesh)
+    rng = jax.device_put(rng, NamedSharding(mesh, P()))
+    with jax.set_mesh(mesh):
+        return programs.schedule_batch(cluster, batch, cfg, rng)
+
+
+def sharded_schedule_sequential(cluster, batch, cfg: programs.ProgramConfig,
+                                rng, mesh: Mesh,
+                                shard_existing_pods: bool = True):
+    """Sequential-replay scan over the mesh: the scan axis (pods, in order)
+    is serial by construction; each step's per-node work shards over
+    "nodes" and the precomputed O(B×P×N) matmuls shard over both axes."""
+    cluster = shard_cluster(cluster, mesh, shard_existing_pods)
+    batch = shard_batch(batch, mesh)
+    rng = jax.device_put(rng, NamedSharding(mesh, P()))
+    with jax.set_mesh(mesh):
+        return sequential.schedule_sequential(cluster, batch, cfg, rng)

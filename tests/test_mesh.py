@@ -1,0 +1,54 @@
+"""Sharded-vs-unsharded equivalence on a virtual CPU mesh.
+
+The driver separately dry-runs __graft_entry__.dryrun_multichip; this test
+additionally checks numerical equivalence: the GSPMD-partitioned program
+(nodes sharded over "nodes", batch + existing pods over "pods") must produce
+exactly the placements of the single-device program.
+"""
+import jax
+import numpy as np
+import pytest
+
+import __graft_entry__ as graft
+from kubetpu.models import programs
+from kubetpu.models.sequential import schedule_sequential
+from kubetpu.parallel import mesh as pmesh
+
+cpu_devices = jax.devices("cpu")
+pytestmark = pytest.mark.skipif(len(cpu_devices) < 8,
+                                reason="needs 8 virtual CPU devices")
+
+
+def _inputs():
+    cluster, batch, cfg = graft._example(n_nodes=32, n_pending=16)
+    cpu0 = cpu_devices[0]
+    cluster = jax.tree.map(lambda x: jax.device_put(x, cpu0), cluster)
+    batch = jax.tree.map(lambda x: jax.device_put(np.asarray(x), cpu0), batch)
+    rng = jax.device_put(jax.random.PRNGKey(7), cpu0)
+    return cluster, batch, cfg, rng
+
+
+def test_sharded_batch_matches_single_device():
+    cluster, batch, cfg, rng = _inputs()
+    ref_res, ref_chosen = programs.schedule_batch(cluster, batch, cfg, rng)
+
+    mesh = pmesh.make_mesh((2, 4), devices=cpu_devices[:8])
+    res, chosen = pmesh.sharded_schedule_batch(cluster, batch, cfg, rng, mesh)
+
+    np.testing.assert_array_equal(np.asarray(ref_res.feasible),
+                                  np.asarray(res.feasible))
+    np.testing.assert_allclose(np.asarray(ref_res.scores),
+                               np.asarray(res.scores), rtol=0, atol=0)
+    np.testing.assert_array_equal(np.asarray(ref_chosen), np.asarray(chosen))
+
+
+def test_sharded_sequential_matches_single_device():
+    cluster, batch, cfg, rng = _inputs()
+    ref = schedule_sequential(cluster, batch, cfg, rng)
+
+    mesh = pmesh.make_mesh((1, 8), devices=cpu_devices[:8])
+    res = pmesh.sharded_schedule_sequential(cluster, batch, cfg, rng, mesh)
+
+    np.testing.assert_array_equal(np.asarray(ref.chosen), np.asarray(res.chosen))
+    np.testing.assert_allclose(np.asarray(ref.requested),
+                               np.asarray(res.requested), rtol=0, atol=0)
