@@ -408,6 +408,11 @@ class PersistentVolume:
     capacity: Dict[str, Any] = field(default_factory=dict)
     node_affinity: Optional[NodeSelector] = None
     storage_class_name: str = ""
+    # volume source (scheduler-relevant subset, for NodeVolumeLimits)
+    aws_elastic_block_store: Optional[str] = None   # volume id
+    gce_persistent_disk: Optional[str] = None       # pd name
+    csi_driver: Optional[str] = None                # driver name
+    csi_volume_handle: Optional[str] = None
     kind: str = "PersistentVolume"
 
 

@@ -1,0 +1,102 @@
+"""Scheduler component configuration objects.
+
+reference: pkg/scheduler/apis/config/types.go — KubeSchedulerConfiguration
+:55, KubeSchedulerProfile :115, Plugins :176, PluginSet :217, Plugin :230,
+DefaultPercentageOfNodesToScore :251.  YAML decoding/defaulting lives in
+kubetpu/apis/load.py; these are the internal (typed) forms.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+DEFAULT_PERCENTAGE_OF_NODES_TO_SCORE = 0  # 0 => adaptive (types.go:251)
+DEFAULT_SCHEDULER_NAME = "default-scheduler"
+
+EXTENSION_POINTS = (
+    "queue_sort", "pre_filter", "filter", "pre_score", "score",
+    "reserve", "permit", "pre_bind", "bind", "post_bind", "unreserve",
+)
+
+
+@dataclass
+class Plugin:
+    """reference: types.go:230 (Plugin — name + weight)."""
+    name: str
+    weight: int = 0
+
+
+@dataclass
+class PluginSet:
+    """reference: types.go:217."""
+    enabled: List[Plugin] = field(default_factory=list)
+    disabled: List[Plugin] = field(default_factory=list)
+
+
+@dataclass
+class Plugins:
+    """One PluginSet per extension point (reference: types.go:176)."""
+    queue_sort: PluginSet = field(default_factory=PluginSet)
+    pre_filter: PluginSet = field(default_factory=PluginSet)
+    filter: PluginSet = field(default_factory=PluginSet)
+    pre_score: PluginSet = field(default_factory=PluginSet)
+    score: PluginSet = field(default_factory=PluginSet)
+    reserve: PluginSet = field(default_factory=PluginSet)
+    permit: PluginSet = field(default_factory=PluginSet)
+    pre_bind: PluginSet = field(default_factory=PluginSet)
+    bind: PluginSet = field(default_factory=PluginSet)
+    post_bind: PluginSet = field(default_factory=PluginSet)
+    unreserve: PluginSet = field(default_factory=PluginSet)
+
+    def apply(self, custom: Optional["Plugins"]) -> "Plugins":
+        """Merge a profile's custom plugins over these defaults
+        (reference: types.go:195 Plugins.Apply / mergePluginSets)."""
+        if custom is None:
+            return self
+        out = Plugins()
+        for ep in EXTENSION_POINTS:
+            default: PluginSet = getattr(self, ep)
+            override: PluginSet = getattr(custom, ep)
+            disabled = {p.name for p in override.disabled}
+            star = "*" in disabled
+            enabled = [p for p in default.enabled
+                       if not star and p.name not in disabled]
+            enabled += list(override.enabled)
+            setattr(out, ep, PluginSet(enabled=enabled))
+        return out
+
+
+@dataclass
+class KubeSchedulerProfile:
+    """reference: types.go:115."""
+    scheduler_name: str = DEFAULT_SCHEDULER_NAME
+    plugins: Optional[Plugins] = None
+    plugin_config: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class KubeSchedulerConfiguration:
+    """reference: types.go:55."""
+    profiles: List[KubeSchedulerProfile] = field(default_factory=list)
+    # scheduling behavior
+    percentage_of_nodes_to_score: int = DEFAULT_PERCENTAGE_OF_NODES_TO_SCORE
+    pod_initial_backoff_seconds: float = 1.0     # types.go:97
+    pod_max_backoff_seconds: float = 10.0        # types.go:103
+    # HA / serving
+    leader_election: bool = False
+    metrics_bind_address: str = ""
+    health_bind_address: str = ""
+    enable_profiling: bool = True                # types.go:76
+    enable_contention_profiling: bool = True
+    # extenders (reference: types.go:72 Extenders)
+    extenders: List[Any] = field(default_factory=list)
+    # TPU extensions
+    batch_size: int = 256        # device batch (B axis); 1 = exact replay
+    mesh_shape: Optional[tuple] = None
+
+    def profile_for(self, name: str) -> Optional[KubeSchedulerProfile]:
+        for p in self.profiles:
+            if p.scheduler_name == name:
+                return p
+        return None

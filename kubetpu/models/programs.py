@@ -65,9 +65,14 @@ class FilterScoreResult(NamedTuple):
     plugin_scores: Dict[str, jnp.ndarray]  # per-plugin weighted [B, N]
 
 
-def run_filters(cluster, batch, cfg: ProgramConfig):
-    """Returns (feasible, unresolvable, node_affinity_ok)."""
+def run_filters(cluster, batch, cfg: ProgramConfig, host_ok=None):
+    """Returns (feasible, unresolvable, node_affinity_ok).  host_ok [B, N]
+    carries the verdicts of host-side (non-tensorized) filter plugins —
+    volumes, out-of-tree — computed by the framework runner and ANDed in
+    here so device and host plugins share one feasibility mask."""
     base = cluster.node_valid[None, :] & batch.valid[:, None]
+    if host_ok is not None:
+        base = base & host_ok
     feasible = base
     unresolvable = jnp.zeros_like(base)
     affinity_ok = K.node_affinity_filter(cluster, batch)
@@ -137,8 +142,10 @@ def run_scores(cluster, batch, cfg: ProgramConfig, feasible, affinity_ok):
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
-def filter_and_score(cluster, batch, cfg: ProgramConfig) -> FilterScoreResult:
-    feasible, unresolvable, affinity_ok = run_filters(cluster, batch, cfg)
+def filter_and_score(cluster, batch, cfg: ProgramConfig,
+                     host_ok=None) -> FilterScoreResult:
+    feasible, unresolvable, affinity_ok = run_filters(cluster, batch, cfg,
+                                                      host_ok)
     scores, per_plugin = run_scores(cluster, batch, cfg, feasible, affinity_ok)
     return FilterScoreResult(feasible=feasible, unresolvable=unresolvable,
                              scores=scores, plugin_scores=per_plugin)
@@ -163,10 +170,10 @@ def select_host(scores: jnp.ndarray, feasible: jnp.ndarray,
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
-def schedule_batch(cluster, batch, cfg: ProgramConfig, rng):
+def schedule_batch(cluster, batch, cfg: ProgramConfig, rng, host_ok=None):
     """One-shot independent scheduling of a batch: every pod scored against
     the same snapshot (no intra-batch interactions).  Used for gang/auction
     modes and as the building block of the sequential scan program."""
-    res = filter_and_score(cluster, batch, cfg)
+    res = filter_and_score(cluster, batch, cfg, host_ok)
     chosen = select_host(res.scores, res.feasible, rng)
     return res, chosen

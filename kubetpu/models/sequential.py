@@ -69,7 +69,8 @@ def _batch_term_matches(terms, batch, B):
 
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=())
 def schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
-                        hard_pod_affinity_weight: float = 1.0) -> SeqResult:
+                        hard_pod_affinity_weight: float = 1.0,
+                        host_ok=None) -> SeqResult:
     B = batch.req.shape[0]
     N = cluster.allocatable.shape[0]
     L = cluster.kv.shape[1]
@@ -78,6 +79,8 @@ def schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
 
     # ---------------- static precompute (batched, MXU-heavy) ----------------
     base = cluster.node_valid[None, :] & batch.valid[:, None]
+    if host_ok is not None:
+        base = base & host_ok
     affinity_ok = K.node_affinity_filter(cluster, batch)
     static_ok = base
     static_unres = jnp.zeros_like(base)

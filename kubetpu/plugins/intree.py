@@ -1,0 +1,197 @@
+"""In-tree plugin declarations + registry.
+
+reference: pkg/scheduler/framework/plugins/registry.go:47-74 (NewInTreeRegistry)
+and the per-plugin packages under pkg/scheduler/framework/plugins/.
+
+Most plugins are *tensorized*: their Filter/Score algorithm lives in the
+device kernels (kubetpu/ops/kernels.py) and the class here only declares
+which kernels implement it, so the framework runner can route them into the
+jitted program's ProgramConfig.  Genuinely host-side plugins (volume
+binding's API writes, the binder) implement the Python methods.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from ..api import types as api
+from ..framework import interface as fw
+from ..framework.interface import Status, TensorPlugin
+
+
+class PrioritySort(fw.QueueSortPlugin):
+    """reference: queuesort/priority_sort.go:40-45."""
+    NAME = "PrioritySort"
+
+    def less(self, a, b) -> bool:
+        pa, pb = a.pod.priority(), b.pod.priority()
+        if pa != pb:
+            return pa > pb
+        return a.timestamp < b.timestamp
+
+    def sort_key(self, qp) -> tuple:
+        return (-qp.pod.priority(), qp.timestamp)
+
+
+class NodeResourcesFit(TensorPlugin, fw.PreFilterPlugin, fw.FilterPlugin):
+    """reference: noderesources/fit.go."""
+    NAME = "NodeResourcesFit"
+    FILTER_KERNEL = "NodeResourcesFit"
+
+
+class NodeResourcesLeastAllocated(TensorPlugin, fw.ScorePlugin):
+    """reference: noderesources/least_allocated.go."""
+    NAME = "NodeResourcesLeastAllocated"
+    SCORE_KERNEL = "NodeResourcesLeastAllocated"
+
+
+class NodeResourcesMostAllocated(TensorPlugin, fw.ScorePlugin):
+    """reference: noderesources/most_allocated.go."""
+    NAME = "NodeResourcesMostAllocated"
+    SCORE_KERNEL = "NodeResourcesMostAllocated"
+
+
+class NodeResourcesBalancedAllocation(TensorPlugin, fw.ScorePlugin):
+    """reference: noderesources/balanced_allocation.go."""
+    NAME = "NodeResourcesBalancedAllocation"
+    SCORE_KERNEL = "NodeResourcesBalancedAllocation"
+
+
+class NodeName(TensorPlugin, fw.FilterPlugin):
+    """reference: nodename/node_name.go."""
+    NAME = "NodeName"
+    FILTER_KERNEL = "NodeName"
+
+
+class NodePorts(TensorPlugin, fw.PreFilterPlugin, fw.FilterPlugin):
+    """reference: nodeports/node_ports.go."""
+    NAME = "NodePorts"
+    FILTER_KERNEL = "NodePorts"
+
+
+class NodeAffinity(TensorPlugin, fw.FilterPlugin, fw.ScorePlugin):
+    """reference: nodeaffinity/node_affinity.go."""
+    NAME = "NodeAffinity"
+    FILTER_KERNEL = "NodeAffinity"
+    SCORE_KERNEL = "NodeAffinity"
+
+
+class NodeUnschedulable(TensorPlugin, fw.FilterPlugin):
+    """reference: nodeunschedulable/node_unschedulable.go."""
+    NAME = "NodeUnschedulable"
+    FILTER_KERNEL = "NodeUnschedulable"
+
+
+class NodePreferAvoidPods(TensorPlugin, fw.ScorePlugin):
+    """reference: nodepreferavoidpods/node_prefer_avoid_pods.go."""
+    NAME = "NodePreferAvoidPods"
+    SCORE_KERNEL = "NodePreferAvoidPods"
+
+
+class TaintToleration(TensorPlugin, fw.FilterPlugin, fw.PreScorePlugin,
+                      fw.ScorePlugin):
+    """reference: tainttoleration/taint_toleration.go."""
+    NAME = "TaintToleration"
+    FILTER_KERNEL = "TaintToleration"
+    SCORE_KERNEL = "TaintToleration"
+
+
+class InterPodAffinity(TensorPlugin, fw.PreFilterPlugin, fw.FilterPlugin,
+                       fw.PreScorePlugin, fw.ScorePlugin):
+    """reference: interpodaffinity/{plugin,filtering,scoring}.go."""
+    NAME = "InterPodAffinity"
+    FILTER_KERNEL = "InterPodAffinity"
+    SCORE_KERNEL = "InterPodAffinity"
+
+    def __init__(self, hard_pod_affinity_weight: int = 1):
+        self.hard_pod_affinity_weight = hard_pod_affinity_weight
+
+
+class PodTopologySpread(TensorPlugin, fw.PreFilterPlugin, fw.FilterPlugin,
+                        fw.PreScorePlugin, fw.ScorePlugin):
+    """reference: podtopologyspread/{plugin,filtering,scoring}.go."""
+    NAME = "PodTopologySpread"
+    FILTER_KERNEL = "PodTopologySpread"
+    SCORE_KERNEL = "PodTopologySpread"
+
+
+class DefaultPodTopologySpread(TensorPlugin, fw.PreScorePlugin, fw.ScorePlugin):
+    """reference: defaultpodtopologyspread/default_pod_topology_spread.go."""
+    NAME = "DefaultPodTopologySpread"
+    SCORE_KERNEL = "DefaultPodTopologySpread"
+
+
+class ImageLocality(TensorPlugin, fw.ScorePlugin):
+    """reference: imagelocality/image_locality.go."""
+    NAME = "ImageLocality"
+    SCORE_KERNEL = "ImageLocality"
+
+
+# ---------------------------------------------------------------------------
+# host-side plugins (volume family is fleshed out in kubetpu/plugins/volumes.py)
+
+
+class DefaultBinder(fw.BindPlugin):
+    """POST pods/<name>/binding via the client (reference:
+    defaultbinder/default_binder.go:50-61)."""
+    NAME = "DefaultBinder"
+
+    def __init__(self, client=None):
+        self.client = client
+
+    def bind(self, state, pod: api.Pod, node_name: str) -> Status:
+        if self.client is None:
+            return Status.error("DefaultBinder: no client configured")
+        try:
+            self.client.bind(pod, node_name)
+        except Exception as e:  # bind failures feed the Forget/requeue path
+            return Status.error(f"binding rejected: {e}")
+        return Status.success()
+
+
+# ---------------------------------------------------------------------------
+# registry
+
+
+Registry = Dict[str, Callable[..., fw.Plugin]]
+
+
+def new_in_tree_registry() -> Registry:
+    """reference: plugins/registry.go:47-74."""
+    from . import volumes
+    return {
+        PrioritySort.NAME: lambda args=None, handle=None: PrioritySort(),
+        NodeResourcesFit.NAME: lambda args=None, handle=None: NodeResourcesFit(),
+        NodeResourcesLeastAllocated.NAME:
+            lambda args=None, handle=None: NodeResourcesLeastAllocated(),
+        NodeResourcesMostAllocated.NAME:
+            lambda args=None, handle=None: NodeResourcesMostAllocated(),
+        NodeResourcesBalancedAllocation.NAME:
+            lambda args=None, handle=None: NodeResourcesBalancedAllocation(),
+        NodeName.NAME: lambda args=None, handle=None: NodeName(),
+        NodePorts.NAME: lambda args=None, handle=None: NodePorts(),
+        NodeAffinity.NAME: lambda args=None, handle=None: NodeAffinity(),
+        NodeUnschedulable.NAME: lambda args=None, handle=None: NodeUnschedulable(),
+        NodePreferAvoidPods.NAME: lambda args=None, handle=None: NodePreferAvoidPods(),
+        TaintToleration.NAME: lambda args=None, handle=None: TaintToleration(),
+        InterPodAffinity.NAME: lambda args=None, handle=None: InterPodAffinity(
+            hard_pod_affinity_weight=(args or {}).get("hardPodAffinityWeight", 1)),
+        PodTopologySpread.NAME: lambda args=None, handle=None: PodTopologySpread(),
+        DefaultPodTopologySpread.NAME:
+            lambda args=None, handle=None: DefaultPodTopologySpread(),
+        ImageLocality.NAME: lambda args=None, handle=None: ImageLocality(),
+        DefaultBinder.NAME: lambda args=None, handle=None: DefaultBinder(
+            client=handle.client if handle else None),
+        volumes.VolumeBinding.NAME:
+            lambda args=None, handle=None: volumes.VolumeBinding(
+                store=handle.client if handle else None),
+        volumes.VolumeRestrictions.NAME:
+            lambda args=None, handle=None: volumes.VolumeRestrictions(
+                store=handle.client if handle else None),
+        volumes.VolumeZone.NAME:
+            lambda args=None, handle=None: volumes.VolumeZone(
+                store=handle.client if handle else None),
+        volumes.NodeVolumeLimits.NAME:
+            lambda args=None, handle=None: volumes.NodeVolumeLimits(
+                store=handle.client if handle else None),
+    }

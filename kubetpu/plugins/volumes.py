@@ -1,0 +1,333 @@
+"""Host-side volume plugin family.
+
+These stay host plugins (not kernels) because they read/write API objects
+(PVCs/PVs) and their per-pod work is small and gated on the pod actually
+using volumes — mirroring where the reference put its complexity:
+  VolumeBinding      reference: volumebinding/volume_binding.go +
+                     pkg/controller/volume/scheduling (SchedulerVolumeBinder)
+  VolumeRestrictions reference: volumerestrictions/volume_restrictions.go
+  VolumeZone         reference: volumezone/volume_zone.go
+  NodeVolumeLimits   reference: nodevolumelimits/{csi,non_csi}.go
+
+The framework runner calls .relevant(pod) first and skips the whole plugin
+for volume-less pods, so the TPU fast path is untouched.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..api import types as api
+from ..framework import interface as fw
+from ..framework.interface import CycleState, Status
+
+ERR_REASON_BIND_CONFLICT = "node(s) didn't find available persistent volumes to bind"
+ERR_REASON_NODE_CONFLICT = "node(s) had volume node affinity conflict"
+ERR_REASON_DISK_CONFLICT = "node(s) had no available disk"
+ERR_REASON_ZONE_CONFLICT = "node(s) had no available volume zone"
+ERR_REASON_MAX_VOLUME_COUNT = "node(s) exceed max volume count"
+
+# zone/region label keys checked by VolumeZone (reference: volume_zone.go:41)
+_ZONE_KEYS = (api.LABEL_ZONE, api.LABEL_REGION, api.LABEL_ZONE_LEGACY,
+              api.LABEL_REGION_LEGACY)
+
+DEFAULT_EBS_LIMIT = 39    # reference: non_csi.go:50 defaultMaxEBSVolumes
+DEFAULT_GCE_PD_LIMIT = 16  # reference: non_csi.go:56 DefaultMaxGCEPDVolumes
+
+
+class _VolumePlugin(fw.Plugin):
+    def __init__(self, store=None):
+        self.store = store
+
+    def relevant(self, pod: api.Pod) -> bool:
+        return bool(pod.spec.volumes)
+
+    def _pvc(self, pod: api.Pod, claim: str) -> Optional[api.PersistentVolumeClaim]:
+        if self.store is None:
+            return None
+        return self.store.get_pvc(pod.namespace, claim)
+
+    def _pv(self, name: str) -> Optional[api.PersistentVolume]:
+        if self.store is None or not name:
+            return None
+        return self.store.get_pv(name)
+
+
+class VolumeBinding(_VolumePlugin, fw.PreFilterPlugin, fw.FilterPlugin,
+                    fw.ReservePlugin, fw.UnreservePlugin, fw.PreBindPlugin,
+                    fw.PostBindPlugin):
+    """Delayed PVC binding (reference: volumebinding/volume_binding.go:223;
+    FindPodVolumes/AssumePodVolumes/BindPodVolumes from
+    pkg/controller/volume/scheduling/scheduler_binder.go)."""
+    NAME = "VolumeBinding"
+    STATE_KEY = "PreFilterVolumeBinding"
+
+    def pre_filter(self, state: CycleState, pod: api.Pod) -> Status:
+        # PVC existence is a basic check (reference:
+        # generic_scheduler.go:1084 podPassesBasicChecks)
+        for v in pod.spec.volumes:
+            if v.persistent_volume_claim:
+                pvc = self._pvc(pod, v.persistent_volume_claim)
+                if pvc is None:
+                    return Status.unresolvable(
+                        f'persistentvolumeclaim "{v.persistent_volume_claim}" '
+                        "not found")
+                if pvc.metadata.deletion_timestamp is not None:
+                    return Status.unresolvable(
+                        f'persistentvolumeclaim "{v.persistent_volume_claim}" '
+                        "is being deleted")
+        return Status.success()
+
+    def filter(self, state: CycleState, pod: api.Pod, node_info) -> Status:
+        """FindPodVolumes (reference: scheduler_binder.go:220): bound PVCs
+        must have node-compatible PVs; unbound PVCs must be matchable or
+        provisionable on this node."""
+        node = node_info.node
+        for v in pod.spec.volumes:
+            if not v.persistent_volume_claim:
+                continue
+            pvc = self._pvc(pod, v.persistent_volume_claim)
+            if pvc is None:
+                return Status.unresolvable("pvc not found")
+            if pvc.volume_name:
+                pv = self._pv(pvc.volume_name)
+                if pv is None or not _pv_matches_node(pv, node):
+                    return Status.unschedulable(ERR_REASON_NODE_CONFLICT)
+            else:
+                if not self._find_matching_pv(pvc, node) \
+                        and not self._provisionable(pvc):
+                    return Status.unschedulable(ERR_REASON_BIND_CONFLICT)
+        return Status.success()
+
+    def _find_matching_pv(self, pvc, node) -> Optional[api.PersistentVolume]:
+        if self.store is None:
+            return None
+        for pv in self.store.list_pvs():
+            if (pv.storage_class_name == pvc.storage_class_name
+                    and _pv_matches_node(pv, node)
+                    and not self.store.pv_is_bound(pv.metadata.name)):
+                return pv
+        return None
+
+    def _provisionable(self, pvc) -> bool:
+        if self.store is None:
+            return False
+        sc = self.store.get_storage_class(pvc.storage_class_name)
+        return sc is not None and sc.volume_binding_mode == "WaitForFirstConsumer"
+
+    def reserve(self, state: CycleState, pod: api.Pod, node_name: str) -> Status:
+        """AssumePodVolumes: pick PVs for unbound claims and cache the
+        decision for pre_bind (reference: volume_binding.go Reserve)."""
+        decisions: List[Tuple[str, str]] = []  # (pvc name, pv name|"" provision)
+        if self.store is not None:
+            node = self.store.get_node(node_name)
+            if node is None:
+                # node deleted between snapshot and commit
+                return Status.error(f"node {node_name} no longer exists")
+            for v in pod.spec.volumes:
+                if not v.persistent_volume_claim:
+                    continue
+                pvc = self._pvc(pod, v.persistent_volume_claim)
+                if pvc is None:
+                    return Status.error("pvc disappeared during reserve")
+                if pvc.volume_name:
+                    continue
+                pv = self._find_matching_pv(pvc, node)
+                if pv is not None:
+                    self.store.assume_pv_binding(pv.metadata.name,
+                                                 pvc.metadata.name)
+                    decisions.append((pvc.metadata.name, pv.metadata.name))
+                elif self._provisionable(pvc):
+                    # delayed provisioning: record the claim so pre_bind can
+                    # stamp the selected node (reference: scheduler_binder
+                    # AssumePodVolumes provisioning decisions)
+                    decisions.append((pvc.metadata.name, ""))
+                else:
+                    # the PV another batch pod just claimed is gone and the
+                    # class can't provision: fail reserve -> requeue
+                    # (reference: AssumePodVolumes error path)
+                    for _, assumed_pv in decisions:
+                        if assumed_pv:
+                            self.store.forget_pv_binding(assumed_pv)
+                    return Status.error(
+                        f"no persistent volume available for claim "
+                        f"{pvc.metadata.name} on {node_name}")
+        state.write(self.STATE_KEY, decisions)
+        return Status.success()
+
+    def unreserve(self, state: CycleState, pod: api.Pod, node_name: str) -> None:
+        try:
+            decisions = state.read(self.STATE_KEY)
+        except KeyError:
+            return
+        if self.store is not None:
+            for _, pv_name in decisions:
+                if pv_name:
+                    self.store.forget_pv_binding(pv_name)
+        state.delete(self.STATE_KEY)
+
+    def pre_bind(self, state: CycleState, pod: api.Pod, node_name: str) -> Status:
+        """BindPodVolumes: write the assumed bindings through the API
+        (reference: volume_binding.go PreBind)."""
+        try:
+            decisions = state.read(self.STATE_KEY)
+        except KeyError:
+            return Status.success()
+        if self.store is not None:
+            for pvc_name, pv_name in decisions:
+                try:
+                    self.store.bind_pvc(pod.namespace, pvc_name, pv_name,
+                                        node_name)
+                except Exception as e:
+                    return Status.error(f"binding volumes: {e}")
+        return Status.success()
+
+    def post_bind(self, state: CycleState, pod: api.Pod, node_name: str) -> None:
+        state.delete(self.STATE_KEY)
+
+
+class VolumeRestrictions(_VolumePlugin, fw.FilterPlugin):
+    """Read-write conflict rules for GCE-PD / EBS / ISCSI / RBD
+    (reference: volumerestrictions/volume_restrictions.go:134)."""
+    NAME = "VolumeRestrictions"
+
+    def relevant(self, pod: api.Pod) -> bool:
+        return any(v.gce_persistent_disk or v.aws_elastic_block_store
+                   or v.iscsi or v.rbd for v in pod.spec.volumes)
+
+    def filter(self, state: CycleState, pod: api.Pod, node_info) -> Status:
+        for v in pod.spec.volumes:
+            for existing in node_info.pods:
+                for ev in existing.pod.spec.volumes:
+                    if _volume_conflict(v, ev):
+                        return Status.unschedulable(ERR_REASON_DISK_CONFLICT)
+        return Status.success()
+
+
+def _volume_conflict(v: api.Volume, ev: api.Volume) -> bool:
+    """reference: volume_restrictions.go:48 isVolumeConflict."""
+    if v.gce_persistent_disk and ev.gce_persistent_disk:
+        if (v.gce_persistent_disk == ev.gce_persistent_disk
+                and not (v.read_only and ev.read_only)):
+            return True
+    if v.aws_elastic_block_store and ev.aws_elastic_block_store:
+        if v.aws_elastic_block_store == ev.aws_elastic_block_store:
+            return True
+    if v.iscsi and ev.iscsi:
+        if v.iscsi == ev.iscsi and not (v.read_only and ev.read_only):
+            return True
+    if v.rbd and ev.rbd:
+        if v.rbd == ev.rbd and not (v.read_only and ev.read_only):
+            return True
+    return False
+
+
+class VolumeZone(_VolumePlugin, fw.FilterPlugin):
+    """Bound PV zone/region labels must match the node
+    (reference: volumezone/volume_zone.go:185)."""
+    NAME = "VolumeZone"
+
+    def filter(self, state: CycleState, pod: api.Pod, node_info) -> Status:
+        node = node_info.node
+        for v in pod.spec.volumes:
+            if not v.persistent_volume_claim:
+                continue
+            pvc = self._pvc(pod, v.persistent_volume_claim)
+            if pvc is None:
+                return Status.unresolvable("pvc not found")
+            pv = self._pv(pvc.volume_name)
+            if pv is None:
+                continue  # unbound: VolumeBinding's problem
+            for key in _ZONE_KEYS:
+                want = pv.metadata.labels.get(key)
+                if want is None:
+                    continue
+                # PV zone labels may hold a __ separated set
+                allowed = set(want.split("__"))
+                have = node.metadata.labels.get(key)
+                if have not in allowed:
+                    return Status.unschedulable(ERR_REASON_ZONE_CONFLICT)
+        return Status.success()
+
+
+class NodeVolumeLimits(_VolumePlugin, fw.FilterPlugin):
+    """Attachable-volume count limits, CSI + in-tree
+    (reference: nodevolumelimits/csi.go + non_csi.go:522)."""
+    NAME = "NodeVolumeLimits"
+
+    def relevant(self, pod: api.Pod) -> bool:
+        return any(v.aws_elastic_block_store or v.gce_persistent_disk
+                   or v.persistent_volume_claim for v in pod.spec.volumes)
+
+    def filter(self, state: CycleState, pod: api.Pod, node_info) -> Status:
+        limits = self._node_limits(node_info)
+        counts: Dict[str, Set[str]] = {}
+        for pi in node_info.pods:
+            self._count(pi.pod, counts)
+        new: Dict[str, Set[str]] = {}
+        self._count(pod, new)
+        for driver, vols in new.items():
+            limit = limits.get(driver)
+            if limit is None:
+                continue
+            total = counts.get(driver, set()) | vols
+            if len(total) > limit:
+                return Status.unschedulable(ERR_REASON_MAX_VOLUME_COUNT)
+        return Status.success()
+
+    def _count(self, pod: api.Pod, out: Dict[str, Set[str]]) -> None:
+        """Tally attachable volumes, resolving PVC -> PV -> source like the
+        reference's filterAttachableVolumes (non_csi.go:338, csi.go:180)."""
+        for v in pod.spec.volumes:
+            if v.aws_elastic_block_store:
+                out.setdefault("ebs", set()).add(v.aws_elastic_block_store)
+            if v.gce_persistent_disk:
+                out.setdefault("gce-pd", set()).add(v.gce_persistent_disk)
+            if v.persistent_volume_claim and self.store is not None:
+                pvc = self.store.get_pvc(pod.namespace,
+                                         v.persistent_volume_claim)
+                pv = self._pv(pvc.volume_name) if pvc else None
+                if pv is None:
+                    continue
+                if pv.aws_elastic_block_store:
+                    out.setdefault("ebs", set()).add(pv.aws_elastic_block_store)
+                if pv.gce_persistent_disk:
+                    out.setdefault("gce-pd", set()).add(pv.gce_persistent_disk)
+                if pv.csi_driver:
+                    out.setdefault(pv.csi_driver, set()).add(
+                        pv.csi_volume_handle or pv.metadata.name)
+
+    def _node_limits(self, node_info) -> Dict[str, int]:
+        limits = {"ebs": DEFAULT_EBS_LIMIT, "gce-pd": DEFAULT_GCE_PD_LIMIT}
+        if self.store is not None and node_info.node is not None:
+            csinode = self.store.get_csinode(node_info.node.name)
+            if csinode is not None:
+                limits.update(csinode.driver_allocatable)
+        return limits
+
+
+def _pv_matches_node(pv: api.PersistentVolume, node: api.Node) -> bool:
+    """PV .spec.nodeAffinity check (reference:
+    pkg/volume/util.CheckNodeAffinity)."""
+    if pv.node_affinity is None:
+        return True
+    labels = node.metadata.labels
+    for term in pv.node_affinity.node_selector_terms:
+        ok = True
+        for req in term.match_expressions:
+            val = labels.get(req.key)
+            if req.operator == "In":
+                ok = ok and val in req.values
+            elif req.operator == "NotIn":
+                # a node missing the key matches NotIn (reference:
+                # apimachinery labels/selector.go Requirement.Matches rule 4)
+                ok = ok and (val is None or val not in req.values)
+            elif req.operator == "Exists":
+                ok = ok and val is not None
+            elif req.operator == "DoesNotExist":
+                ok = ok and val is None
+            else:
+                ok = False
+        if ok:
+            return True
+    return False

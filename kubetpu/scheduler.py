@@ -1,0 +1,476 @@
+"""Scheduler core: the serving loop tying queue, cache, framework and the
+device programs together.
+
+reference: pkg/scheduler/scheduler.go (Scheduler :69, New :210, Run :339,
+scheduleOne :509, assume :435, bind :457, recordSchedulingFailure :391,
+skipPodSchedule :391) and pkg/scheduler/eventhandlers.go (addAllEventHandlers
+:362).  The reference schedules one pod per cycle; this scheduler pops a
+BATCH from the queue and runs the whole batch through one jitted
+sequential-replay program (kubetpu/models/sequential.py), preserving the
+serial semantics (pod i sees placements 0..i-1) while amortizing all host
+work — the design lever named in SURVEY.md §7 step 2.
+
+Cycle pipeline (mirroring scheduleOne's phases):
+  pop batch -> snapshot (incremental) -> tensorize -> PreFilter(host) +
+  host filter masks -> DEVICE filter+score+select (scan) ->
+  per pod: Reserve -> assume -> Permit -> async bind cycle
+  (WaitOnPermit -> PreBind -> Bind -> FinishBinding -> PostBind)
+with failures flowing through Unreserve -> ForgetPod ->
+recordSchedulingFailure exactly like the reference (scheduler.go:586-687).
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .api import types as api
+from .apis.config import (KubeSchedulerConfiguration, KubeSchedulerProfile)
+from .client.store import ClusterStore
+from .framework import interface as fw
+from .framework.interface import Code, CycleState, Status
+from .framework.runtime import Framework
+from .framework.types import NodeInfo, PodInfo, QueuedPodInfo
+from .models import programs
+from .models.batch import PodBatchBuilder
+from .models.sequential import schedule_sequential
+from .plugins.intree import new_in_tree_registry
+from .schedqueue.queue import SchedulingQueue
+from .state.cache import SchedulerCache, Snapshot
+from .state.tensors import SnapshotBuilder
+
+
+@dataclass
+class ScheduleOutcome:
+    pod: api.Pod
+    node: str = ""                 # "" => unschedulable
+    err: Optional[str] = None
+    n_feasible: int = 0
+    preemption_may_help: bool = True
+
+
+class Scheduler:
+    """reference: scheduler.go:69."""
+
+    def __init__(self, store: ClusterStore,
+                 config: Optional[KubeSchedulerConfiguration] = None,
+                 registry=None, seed: int = 0, async_binding: bool = True,
+                 metrics=None, recorder=None):
+        import jax
+        self.store = store
+        self.config = config or KubeSchedulerConfiguration(
+            profiles=[KubeSchedulerProfile()])
+        if not self.config.profiles:
+            self.config.profiles = [KubeSchedulerProfile()]
+        self.metrics = metrics
+        self.recorder = recorder
+        self.cache = SchedulerCache()
+        registry = registry or new_in_tree_registry()
+
+        # one framework per profile (reference: profile/profile.go:59 Map)
+        self.profiles: Dict[str, Framework] = {}
+        for prof in self.config.profiles:
+            self.profiles[prof.scheduler_name] = Framework(
+                registry, prof, client=store, metrics=metrics)
+
+        any_fw = next(iter(self.profiles.values()))
+        self.queue = SchedulingQueue(
+            sort_key=any_fw.queue_sort_key,
+            pod_initial_backoff=self.config.pod_initial_backoff_seconds,
+            pod_max_backoff=self.config.pod_max_backoff_seconds,
+            metrics=metrics)
+        self.snapshot = Snapshot()
+        self._rng_counter = seed
+        self._jax = jax
+        self._async_binding = async_binding
+        self._bind_pool = ThreadPoolExecutor(max_workers=16,
+                                             thread_name_prefix="binder")
+        self._inflight_binds: List = []
+        self._stop = threading.Event()
+        self._add_all_event_handlers()
+        self.preemptor = None  # attached by kubetpu.preemption
+
+    # ------------------------------------------------------------------ events
+
+    def _add_all_event_handlers(self) -> None:
+        """reference: eventhandlers.go:362 addAllEventHandlers."""
+        s = self.store
+
+        def on_pod(event: str, old, new) -> None:
+            pod = new if new is not None else old
+            if event == "add":
+                if pod.spec.node_name:
+                    self._add_pod_to_cache(pod)
+                elif self._responsible(pod):
+                    self.queue.add(pod)
+            elif event == "update":
+                was_assigned = bool(old.spec.node_name)
+                is_assigned = bool(new.spec.node_name)
+                if is_assigned and not was_assigned:
+                    # bind confirmed (possibly our own optimistic assume)
+                    self._add_pod_to_cache(new)
+                    self.queue.delete(old)
+                    self.queue.assigned_pod_added(new)
+                elif is_assigned:
+                    self._update_pod_in_cache(old, new)
+                    self.queue.assigned_pod_updated(new)
+                elif self._responsible(new) and not self._skip_pod_update(old, new):
+                    self.queue.update(old, new)
+            elif event == "delete":
+                if pod.spec.node_name:
+                    try:
+                        self.cache.remove_pod(pod)
+                    except ValueError:
+                        pass
+                    self.queue.move_all_to_active_or_backoff_queue("PodDelete")
+                else:
+                    self.queue.delete(pod)
+                    fwk = self.profiles.get(pod.spec.scheduler_name)
+                    if fwk is not None:
+                        fwk.reject_waiting_pod(pod.uid)
+
+        def on_node(event: str, old, new) -> None:
+            if event == "add":
+                self.cache.add_node(new)
+                self.queue.move_all_to_active_or_backoff_queue("NodeAdd")
+            elif event == "update":
+                self.cache.update_node(old, new)
+                if self._node_scheduling_properties_changed(old, new):
+                    self.queue.move_all_to_active_or_backoff_queue("NodeUpdate")
+            elif event == "delete":
+                try:
+                    self.cache.remove_node(old)
+                except ValueError:
+                    pass
+
+        def on_moveable(kind: str):
+            def handler(event: str, old, new) -> None:
+                self.queue.move_all_to_active_or_backoff_queue(f"{kind}{event.title()}")
+            return handler
+
+        s.subscribe("Pod", on_pod)
+        s.subscribe("Node", on_node)
+        for kind in ("PersistentVolume", "PersistentVolumeClaim",
+                     "StorageClass", "Service", "CSINode"):
+            s.subscribe(kind, on_moveable(kind))
+
+    def _add_pod_to_cache(self, pod: api.Pod) -> None:
+        try:
+            self.cache.add_pod(pod)
+        except ValueError:
+            # already assumed on another node etc. — cache resolves
+            pass
+
+    def _update_pod_in_cache(self, old: api.Pod, new: api.Pod) -> None:
+        try:
+            self.cache.update_pod(old, new)
+        except ValueError:
+            self._add_pod_to_cache(new)
+
+    def _responsible(self, pod: api.Pod) -> bool:
+        # reference: eventhandlers.go:333 responsibleForPod
+        return pod.spec.scheduler_name in self.profiles
+
+    @staticmethod
+    def _skip_pod_update(old: api.Pod, new: api.Pod) -> bool:
+        """reference: eventhandlers.go:311 skipPodUpdate — only
+        resourceVersion/status-ish changes."""
+        return (old.spec == new.spec
+                and old.metadata.labels == new.metadata.labels
+                and old.metadata.annotations == new.metadata.annotations)
+
+    @staticmethod
+    def _node_scheduling_properties_changed(old: api.Node, new: api.Node) -> bool:
+        # reference: eventhandlers.go:471
+        return (old.spec.unschedulable != new.spec.unschedulable
+                or old.metadata.labels != new.metadata.labels
+                or old.spec.taints != new.spec.taints
+                or old.status.allocatable != new.status.allocatable)
+
+    # ------------------------------------------------------------------ cycle
+
+    def _next_rng(self):
+        self._rng_counter += 1
+        return self._jax.random.PRNGKey(self._rng_counter)
+
+    def schedule_pending(self, max_batch: Optional[int] = None,
+                         timeout: float = 0.0) -> List[ScheduleOutcome]:
+        """Run ONE batched scheduling cycle: pop up to batch_size pods and
+        schedule them.  Returns outcomes (the test/introspection surface).
+        The serving loop (run/serve_forever) just calls this repeatedly."""
+        max_batch = max_batch or self.config.batch_size
+        batch = self.queue.pop_batch(max_batch, timeout=timeout)
+        if not batch:
+            return []
+        return self._schedule_batch(batch)
+
+    def _schedule_batch(self, qpods: List[QueuedPodInfo]) -> List[ScheduleOutcome]:
+        start = time.time()
+        # group by profile: one device program per framework config
+        outcomes: List[ScheduleOutcome] = []
+        by_profile: Dict[str, List[QueuedPodInfo]] = {}
+        for qp in qpods:
+            if self._skip_pod_schedule(qp.pod):
+                continue
+            by_profile.setdefault(qp.pod.spec.scheduler_name, []).append(qp)
+        for name, group in by_profile.items():
+            fwk = self.profiles[name]
+            outcomes.extend(self._schedule_group(fwk, group))
+        if self.metrics:
+            self.metrics.observe_cycle(len(outcomes), time.time() - start)
+        return outcomes
+
+    def _skip_pod_schedule(self, pod: api.Pod) -> bool:
+        """reference: scheduler.go:691 skipPodSchedule — deleted or
+        assumed-and-updated-only pods."""
+        current = self.store.get_pod(pod.namespace, pod.metadata.name)
+        if current is None or current.metadata.deletion_timestamp is not None:
+            return True
+        if self.cache.is_assumed_pod(pod):
+            return True
+        return False
+
+    def _schedule_group(self, fwk: Framework,
+                        qpods: List[QueuedPodInfo]) -> List[ScheduleOutcome]:
+        # ---- snapshot (reference: generic_scheduler.go:155 snapshot())
+        self.cache.update_snapshot(self.snapshot)
+        node_infos = self.snapshot.node_info_list
+        n_nodes = len(node_infos)
+
+        # ---- host PreFilter + basic checks; build scheduleable set
+        states: Dict[str, CycleState] = {}
+        live: List[QueuedPodInfo] = []
+        outcomes: List[ScheduleOutcome] = []
+        for qp in qpods:
+            state = CycleState()
+            st = fwk.run_pre_filter_plugins(state, qp.pod)
+            if not st.is_success():
+                outcomes.append(self._fail(fwk, qp, state, "",
+                                           st.message() or "prefilter failed",
+                                           preemption_may_help=not st.code
+                                           == Code.UNSCHEDULABLE_AND_UNRESOLVABLE))
+                continue
+            states[qp.pod.uid] = state
+            live.append(qp)
+        if not live:
+            return outcomes
+        if n_nodes == 0:
+            for qp in live:
+                outcomes.append(self._fail(fwk, qp, states[qp.pod.uid], "",
+                                           "0/0 nodes are available",
+                                           preemption_may_help=False))
+            return outcomes
+
+        # ---- tensorize
+        builder = SnapshotBuilder(
+            hard_pod_affinity_weight=fwk.hard_pod_affinity_weight)
+        pinfos = [PodInfo(qp.pod) for qp in live]
+        builder.intern_pending(pinfos)
+        host_arrays = builder.build(node_infos)
+        cluster = host_arrays.to_device()
+        spread_sels = [self.store.default_spread_selector(pi.pod)
+                       for pi in pinfos]
+        pb = PodBatchBuilder(builder.table)
+        batch = self._jax.tree.map(np.asarray,
+                                   pb.build(pinfos, spread_selectors=spread_sels))
+        B = batch.valid.shape[0]
+        N = cluster.allocatable.shape[0]
+
+        # ---- host filter plugins -> mask fed into the device program
+        host_ok = np.ones((B, N), bool)
+        any_host = False
+        for i, qp in enumerate(live):
+            if not fwk.has_relevant_host_filters(qp.pod):
+                continue
+            any_host = True
+            state = states[qp.pod.uid]
+            for j, ni in enumerate(node_infos):
+                st = fwk.run_filter_plugins(state, qp.pod, ni)
+                host_ok[i, j] = st.is_success()
+        cfg = programs.ProgramConfig(
+            filters=fwk.tensor_filters, scores=fwk.tensor_scores,
+            hostname_topokey=max(builder.table.topokey.get(api.LABEL_HOSTNAME), 0))
+
+        # ---- device: one scan for the whole group
+        res = schedule_sequential(
+            cluster, batch, cfg, self._next_rng(),
+            hard_pod_affinity_weight=float(fwk.hard_pod_affinity_weight),
+            host_ok=self._jax.numpy.asarray(host_ok) if any_host else None)
+        chosen = np.asarray(res.chosen)[:len(live)]
+        n_feas = np.asarray(res.n_feasible)[:len(live)]
+        unres = np.asarray(res.all_unresolvable)[:len(live)]
+
+        # ---- commit each placement in scan order
+        for i, qp in enumerate(live):
+            state = states[qp.pod.uid]
+            if chosen[i] < 0:
+                outcomes.append(self._fail(
+                    fwk, qp, state, "",
+                    f"0/{n_nodes} nodes are available",
+                    preemption_may_help=not bool(unres[i])))
+                continue
+            node_name = node_infos[int(chosen[i])].node_name
+            outcome = self._commit(fwk, qp, state, node_name,
+                                   int(n_feas[i]))
+            outcomes.append(outcome)
+        return outcomes
+
+    # ------------------------------------------------------------------ commit
+
+    def _commit(self, fwk: Framework, qp: QueuedPodInfo, state: CycleState,
+                node_name: str, n_feasible: int) -> ScheduleOutcome:
+        pod = qp.pod
+        # Reserve (reference: scheduler.go:586)
+        st = fwk.run_reserve_plugins(state, pod, node_name)
+        if not st.is_success():
+            fwk.run_unreserve_plugins(state, pod, node_name)
+            return self._fail(fwk, qp, state, node_name, st.message())
+
+        # assume (reference: scheduler.go:435,593)
+        assumed = copy.deepcopy(pod)
+        assumed.spec.node_name = node_name
+        try:
+            self.cache.assume_pod(assumed)
+        except ValueError as e:
+            fwk.run_unreserve_plugins(state, pod, node_name)
+            return self._fail(fwk, qp, state, node_name, str(e))
+
+        # Permit (reference: scheduler.go:608)
+        st = fwk.run_permit_plugins(state, pod, node_name)
+        if not st.is_success() and st.code != Code.WAIT:
+            self._forget(assumed)
+            fwk.run_unreserve_plugins(state, pod, node_name)
+            return self._fail(fwk, qp, state, node_name, st.message())
+
+        # binding cycle (reference: scheduler.go:628 goroutine)
+        if self._async_binding:
+            fut = self._bind_pool.submit(self._bind_cycle, fwk, qp, state,
+                                         assumed, node_name)
+            # prune completed futures so a long-running scheduler doesn't
+            # retain one CycleState + pod copy per scheduled pod
+            self._inflight_binds = [f for f in self._inflight_binds
+                                    if not f.done()]
+            self._inflight_binds.append(fut)
+            err = None
+        else:
+            err = self._bind_cycle(fwk, qp, state, assumed, node_name)
+        return ScheduleOutcome(pod=pod, node=node_name if err is None else "",
+                               err=err, n_feasible=n_feasible)
+
+    def _bind_cycle(self, fwk: Framework, qp: QueuedPodInfo, state: CycleState,
+                    assumed: api.Pod, node_name: str) -> Optional[str]:
+        """reference: scheduler.go:628-687."""
+        pod = qp.pod
+        st = fwk.wait_on_permit(pod)
+        if not st.is_success():
+            self._forget(assumed)
+            fwk.run_unreserve_plugins(state, pod, node_name)
+            self._record_failure(fwk, qp, st.message())
+            return st.message() or "permit rejected"
+        st = fwk.run_pre_bind_plugins(state, pod, node_name)
+        if not st.is_success():
+            self._forget(assumed)
+            fwk.run_unreserve_plugins(state, pod, node_name)
+            self._record_failure(fwk, qp, st.message())
+            return st.message() or "prebind failed"
+        st = fwk.run_bind_plugins(state, pod, node_name)
+        if not st.is_success():
+            self._forget(assumed)
+            fwk.run_unreserve_plugins(state, pod, node_name)
+            self._record_failure(fwk, qp, st.message())
+            return st.message() or "bind failed"
+        self.cache.finish_binding(assumed)
+        fwk.run_post_bind_plugins(state, pod, node_name)
+        if self.recorder:
+            self.recorder.event(pod, "Normal", "Scheduled",
+                                f"Successfully assigned "
+                                f"{pod.namespace}/{pod.metadata.name} to "
+                                f"{node_name}")
+        return None
+
+    def _forget(self, assumed: api.Pod) -> None:
+        try:
+            self.cache.forget_pod(assumed)
+        except ValueError:
+            pass
+
+    # ------------------------------------------------------------------ failure
+
+    def _fail(self, fwk: Framework, qp: QueuedPodInfo, state: CycleState,
+              node_name: str, message: str,
+              preemption_may_help: bool = True) -> ScheduleOutcome:
+        """reference: scheduler.go:391 recordSchedulingFailure +
+        :542-563 (preemption trigger + requeue + condition patch)."""
+        pod = qp.pod
+        nominated = ""
+        if preemption_may_help and self.preemptor is not None:
+            nominated = self.preemptor.preempt(fwk, state, pod) or ""
+        self._record_failure(fwk, qp, message, nominated)
+        return ScheduleOutcome(pod=pod, node="", err=message,
+                               preemption_may_help=preemption_may_help)
+
+    def _record_failure(self, fwk: Framework, qp: QueuedPodInfo,
+                        message: str, nominated_node: str = "") -> None:
+        pod = qp.pod
+        try:
+            self.queue.add_unschedulable_if_not_present(
+                qp, self.queue.scheduling_cycle)
+        except ValueError:
+            pass
+        if self.recorder:
+            self.recorder.event(pod, "Warning", "FailedScheduling", message)
+        try:
+            self.store.update_pod_condition(
+                pod,
+                api.PodCondition(type=api.POD_SCHEDULED, status="False",
+                                 reason=api.REASON_UNSCHEDULABLE,
+                                 message=message),
+                nominated_node_name=nominated_node)
+        except Exception:
+            pass
+        if self.metrics:
+            self.metrics.pod_unschedulable()
+
+    # ------------------------------------------------------------------ loop
+
+    def run(self) -> threading.Thread:
+        """Start the serving loop (reference: scheduler.go:339 Run)."""
+        self.queue.run()
+        self.cache.run()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.schedule_pending(timeout=0.2)
+                except Exception:  # the serving loop must never die
+                    # (reference: wait.UntilWithContext keeps scheduleOne
+                    # running; per-pod errors go through
+                    # recordSchedulingFailure, anything else is logged)
+                    import logging
+                    import traceback
+                    logging.getLogger("kubetpu").error(
+                        "scheduling cycle panicked:\n%s",
+                        traceback.format_exc())
+                    time.sleep(0.1)
+        t = threading.Thread(target=loop, daemon=True,
+                             name="kubetpu-scheduler")
+        t.start()
+        return t
+
+    def wait_for_inflight_binds(self, timeout: float = 10.0) -> None:
+        deadline = time.time() + timeout
+        for fut in list(self._inflight_binds):
+            fut.result(timeout=max(0.0, deadline - time.time()))
+        self._inflight_binds = [f for f in self._inflight_binds if not f.done()]
+
+    def close(self) -> None:
+        self._stop.set()
+        self.queue.close()
+        self.cache.close()
+        self._bind_pool.shutdown(wait=False)

@@ -1,0 +1,188 @@
+"""Scheduler cache assume/forget + incremental snapshot behavior
+(reference: pkg/scheduler/internal/cache/cache_test.go)."""
+import pytest
+
+from kubetpu.harness import hollow
+from kubetpu.state.cache import SchedulerCache, Snapshot
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def bound(pod, node):
+    pod.spec.node_name = node
+    return pod
+
+
+def test_assume_then_confirm():
+    c = SchedulerCache()
+    c.add_node(hollow.make_node("n1"))
+    p = bound(hollow.make_pod("p", cpu_milli=500), "n1")
+    c.assume_pod(p)
+    assert c.is_assumed_pod(p)
+    assert c.nodes["n1"].info.requested.milli_cpu == 500
+    c.add_pod(p)   # watch confirms
+    assert not c.is_assumed_pod(p)
+    assert c.nodes["n1"].info.requested.milli_cpu == 500  # not double-counted
+
+
+def test_forget_restores_resources():
+    c = SchedulerCache()
+    c.add_node(hollow.make_node("n1"))
+    p = bound(hollow.make_pod("p", cpu_milli=500), "n1")
+    c.assume_pod(p)
+    c.forget_pod(p)
+    assert c.nodes["n1"].info.requested.milli_cpu == 0
+    assert c.get_pod(p) is None
+
+
+def test_assumed_pod_expires_after_ttl():
+    clock = FakeClock()
+    c = SchedulerCache(ttl=30.0, clock=clock)
+    c.add_node(hollow.make_node("n1"))
+    p = bound(hollow.make_pod("p", cpu_milli=500), "n1")
+    c.assume_pod(p)
+    c.finish_binding(p)
+    clock.t += 29
+    c.cleanup_assumed_pods()
+    assert c.is_assumed_pod(p)
+    clock.t += 2
+    c.cleanup_assumed_pods()
+    assert not c.is_assumed_pod(p)
+    assert c.nodes["n1"].info.requested.milli_cpu == 0
+
+
+def test_unfinished_binding_never_expires():
+    clock = FakeClock()
+    c = SchedulerCache(ttl=30.0, clock=clock)
+    c.add_node(hollow.make_node("n1"))
+    p = bound(hollow.make_pod("p"), "n1")
+    c.assume_pod(p)
+    clock.t += 1000
+    c.cleanup_assumed_pods()
+    assert c.is_assumed_pod(p)
+
+
+def test_add_pod_different_node_than_assumed():
+    c = SchedulerCache()
+    c.add_node(hollow.make_node("n1"))
+    c.add_node(hollow.make_node("n2"))
+    import copy
+    p = bound(hollow.make_pod("p", cpu_milli=300), "n1")
+    c.assume_pod(p)
+    actual = copy.deepcopy(p)
+    actual.spec.node_name = "n2"
+    c.add_pod(actual)
+    assert c.nodes["n1"].info.requested.milli_cpu == 0
+    assert c.nodes["n2"].info.requested.milli_cpu == 300
+
+
+def test_update_and_remove_pod():
+    c = SchedulerCache()
+    c.add_node(hollow.make_node("n1"))
+    p = bound(hollow.make_pod("p", cpu_milli=100), "n1")
+    c.add_pod(p)
+    import copy
+    p2 = copy.deepcopy(p)
+    p2.spec.containers[0].resources.requests["cpu"] = "700m"
+    c.update_pod(p, p2)
+    assert c.nodes["n1"].info.requested.milli_cpu == 700
+    c.remove_pod(p2)
+    assert c.nodes["n1"].info.requested.milli_cpu == 0
+
+
+def test_snapshot_incremental_only_copies_changed():
+    c = SchedulerCache()
+    for i in range(4):
+        c.add_node(hollow.make_node(f"n{i}"))
+    snap = Snapshot()
+    c.update_snapshot(snap)
+    assert snap.num_nodes() == 4
+    before = {n: id(ni) for n, ni in snap.node_info_map.items()}
+    # touch one node only
+    c.add_pod(bound(hollow.make_pod("p"), "n2"))
+    c.update_snapshot(snap)
+    after = {n: id(ni) for n, ni in snap.node_info_map.items()}
+    assert before["n0"] == after["n0"]          # untouched: same clone
+    assert before["n2"] != after["n2"]          # changed: re-cloned
+    assert len(snap.node_info_map["n2"].pods) == 1
+
+
+def test_snapshot_removed_node_pruned():
+    c = SchedulerCache()
+    n0, n1 = hollow.make_node("n0"), hollow.make_node("n1")
+    c.add_node(n0)
+    c.add_node(n1)
+    snap = Snapshot()
+    c.update_snapshot(snap)
+    c.remove_node(n1)
+    c.update_snapshot(snap)
+    assert snap.num_nodes() == 1
+    assert snap.get("n1") is None
+
+
+def test_snapshot_zone_interleaving():
+    c = SchedulerCache()
+    # 2 zones x 2 nodes: list order must interleave zones
+    for i in range(4):
+        c.add_node(hollow.make_node(f"n{i}", zone=f"z{i // 2}",
+                                    region="r"))
+    snap = Snapshot()
+    c.update_snapshot(snap)
+    order = [ni.node_name for ni in snap.node_info_list]
+    zones = [int(n[1]) // 2 for n in order]
+    assert zones == [0, 1, 0, 1]
+
+
+def test_snapshot_affinity_list():
+    c = SchedulerCache()
+    c.add_node(hollow.make_node("n1"))
+    p = bound(hollow.with_anti_affinity(
+        hollow.make_pod("p", labels={"app": "a"})), "n1")
+    c.add_pod(p)
+    snap = Snapshot()
+    c.update_snapshot(snap)
+    assert [ni.node_name for ni in snap.have_pods_with_affinity_list] == ["n1"]
+
+
+def test_double_assume_rejected():
+    c = SchedulerCache()
+    c.add_node(hollow.make_node("n1"))
+    p = bound(hollow.make_pod("p"), "n1")
+    c.assume_pod(p)
+    with pytest.raises(ValueError):
+        c.assume_pod(p)
+
+
+def test_remove_node_keeps_info_while_pods_remain():
+    c = SchedulerCache()
+    n = hollow.make_node("n1")
+    c.add_node(n)
+    p = bound(hollow.make_pod("p"), "n1")
+    c.add_pod(p)
+    c.remove_node(n)
+    assert "n1" in c.nodes          # ghost info retained
+    c.remove_pod(p)
+    assert "n1" not in c.nodes      # now garbage-collected
+
+
+def test_snapshot_evicts_ghost_node_with_pods():
+    """Regression: a removed node whose NodeInfo lingers (pods attached)
+    must still be evicted from the snapshot map."""
+    c = SchedulerCache()
+    n0, n1 = hollow.make_node("n0"), hollow.make_node("n1")
+    c.add_node(n0)
+    c.add_node(n1)
+    p = bound(hollow.make_pod("p"), "n1")
+    c.add_pod(p)
+    snap = Snapshot()
+    c.update_snapshot(snap)
+    c.remove_node(n1)          # NodeInfo stays (pod attached), node gone
+    c.update_snapshot(snap)
+    assert snap.get("n1") is None
+    assert [ni.node_name for ni in snap.node_info_list] == ["n0"]

@@ -1,0 +1,197 @@
+"""Scheduling-queue behavior, mirroring the reference's table-driven cases
+(reference: pkg/scheduler/internal/queue/scheduling_queue_test.go)."""
+import pytest
+
+from kubetpu.framework.types import QueuedPodInfo
+from kubetpu.harness import hollow
+from kubetpu.schedqueue.queue import SchedulingQueue
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def step(self, dt):
+        self.t += dt
+
+
+def make_queue(clock=None):
+    return SchedulingQueue(clock=clock or FakeClock())
+
+
+def test_pop_priority_then_fifo():
+    clock = FakeClock()
+    q = make_queue(clock)
+    low = hollow.make_pod("low", priority=1)
+    clock.step(1)
+    high = hollow.make_pod("high", priority=10)
+    clock.step(1)
+    low2 = hollow.make_pod("low2", priority=1)
+    for p in (low, high, low2):
+        q.add(p)
+    assert q.pop().pod.metadata.name == "high"
+    assert q.pop().pod.metadata.name == "low"   # FIFO among equal priority
+    assert q.pop().pod.metadata.name == "low2"
+
+
+def test_pop_blocks_with_timeout():
+    q = make_queue()
+    assert q.pop(timeout=0.05) is None
+
+
+def test_unschedulable_goes_to_unschedulable_q():
+    clock = FakeClock()
+    q = make_queue(clock)
+    q.add(hollow.make_pod("p"))
+    qp = q.pop()
+    cycle = q.scheduling_cycle
+    q.add_unschedulable_if_not_present(qp, cycle)
+    assert len(q.unschedulable_q) == 1
+    assert len(q.active_q) == 0
+
+
+def test_unschedulable_with_move_request_goes_to_backoff():
+    """A cluster event during the pod's cycle routes the failure to
+    backoffQ (reference: scheduling_queue.go:316-326)."""
+    clock = FakeClock()
+    q = make_queue(clock)
+    q.add(hollow.make_pod("p"))
+    qp = q.pop()
+    cycle = q.scheduling_cycle
+    q.move_all_to_active_or_backoff_queue("NodeAdd")   # bumps moveRequestCycle
+    q.add_unschedulable_if_not_present(qp, cycle)
+    assert len(q.backoff_q) == 1
+    assert len(q.unschedulable_q) == 0
+
+
+def test_move_all_respects_backoff():
+    clock = FakeClock()
+    q = make_queue(clock)
+    q.add(hollow.make_pod("p"))
+    qp = q.pop()
+    q.add_unschedulable_if_not_present(qp, q.scheduling_cycle)
+    # still backing off (1s initial): moves to backoffQ
+    q.move_all_to_active_or_backoff_queue("NodeAdd")
+    assert len(q.backoff_q) == 1 and len(q.active_q) == 0
+    # after backoff expires the flush promotes it
+    clock.step(2.0)
+    q.flush_backoff_completed()
+    assert len(q.active_q) == 1
+    assert q.pop().pod.metadata.name == "p"
+
+
+def test_backoff_exponential_and_capped():
+    clock = FakeClock()
+    q = make_queue(clock)
+    qp = QueuedPodInfo(pod=hollow.make_pod("p"), timestamp=clock())
+    qp.attempts = 1
+    assert q._backoff_time(qp) - qp.timestamp == pytest.approx(1.0)
+    qp.attempts = 3
+    assert q._backoff_time(qp) - qp.timestamp == pytest.approx(4.0)
+    qp.attempts = 10
+    assert q._backoff_time(qp) - qp.timestamp == pytest.approx(10.0)  # cap
+
+
+def test_flush_unschedulable_leftover_after_timeout():
+    clock = FakeClock()
+    q = make_queue(clock)
+    q.add(hollow.make_pod("p"))
+    qp = q.pop()
+    q.add_unschedulable_if_not_present(qp, q.scheduling_cycle)
+    clock.step(30.0)
+    q.flush_unschedulable_leftover()
+    assert len(q.unschedulable_q) == 1   # under the 60 s stay
+    clock.step(31.0)
+    q.flush_unschedulable_leftover()
+    assert len(q.unschedulable_q) == 0
+    assert len(q.active_q) == 1          # backoff long expired
+
+
+def test_assigned_pod_added_moves_only_affinity_pods():
+    clock = FakeClock()
+    q = make_queue(clock)
+    plain = hollow.make_pod("plain")
+    aff = hollow.with_affinity(hollow.make_pod("aff", labels={"app": "a"}))
+    for p in (plain, aff):
+        q.add(p)
+        qp = q.pop()
+        q.add_unschedulable_if_not_present(qp, q.scheduling_cycle)
+    clock.step(20.0)  # both past backoff
+    q.assigned_pod_added(hollow.make_pod("bound", labels={"app": "a"}))
+    assert {p.metadata.name for p in
+            (qp.pod for qp in q.active_q.list())} == {"aff"}
+    assert "default/plain" in q.unschedulable_q
+
+
+def test_pop_batch_drains_in_order():
+    clock = FakeClock()
+    q = make_queue(clock)
+    for i, prio in enumerate([5, 1, 9]):
+        q.add(hollow.make_pod(f"p{i}", priority=prio))
+    batch = q.pop_batch(10)
+    assert [qp.pod.metadata.name for qp in batch] == ["p2", "p0", "p1"]
+    assert all(qp.attempts == 1 for qp in batch)
+
+
+def test_update_unschedulable_pod_moves_when_spec_changes():
+    clock = FakeClock()
+    q = make_queue(clock)
+    p = hollow.make_pod("p")
+    q.add(p)
+    qp = q.pop()
+    q.add_unschedulable_if_not_present(qp, q.scheduling_cycle)
+    clock.step(15.0)
+    import copy
+    newp = copy.deepcopy(p)
+    newp.metadata.labels["x"] = "y"
+    q.update(p, newp)
+    assert len(q.unschedulable_q) == 0
+    assert len(q.active_q) == 1
+
+
+def test_delete_removes_everywhere():
+    q = make_queue()
+    p = hollow.make_pod("p")
+    q.add(p)
+    q.delete(p)
+    assert len(q) == 0
+
+
+def test_nominated_pods():
+    q = make_queue()
+    p = hollow.make_pod("p")
+    q.add_nominated_pod(p, "node-1")
+    assert [x.metadata.name for x in q.nominated_pods_for_node("node-1")] == ["p"]
+    q.delete_nominated_pod_if_exists(p)
+    assert q.nominated_pods_for_node("node-1") == []
+
+
+def test_update_priority_reorders_heap():
+    """Regression: in-place QueuedPodInfo mutation must not corrupt the
+    activeQ heap — sort keys are frozen at push time, updates re-push."""
+    import copy
+    import random
+    rng = random.Random(42)
+    clock = FakeClock()
+    q = make_queue(clock)
+    pods = []
+    for i in range(12):
+        p = hollow.make_pod(f"p{i}", priority=rng.randint(0, 100))
+        pods.append(p)
+        q.add(p)
+        clock.step(0.001)
+    for p in rng.sample(pods, 6):
+        newp = copy.deepcopy(p)
+        newp.spec.priority = rng.randint(0, 100)
+        q.update(p, newp)
+    popped = []
+    while True:
+        qp = q.pop(timeout=0.0) if len(q.active_q) else None
+        if qp is None:
+            break
+        popped.append(qp.pod.priority())
+    assert len(popped) == 12
+    assert popped == sorted(popped, reverse=True)
