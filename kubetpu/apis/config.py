@@ -89,6 +89,7 @@ class KubeSchedulerConfiguration:
     health_bind_address: str = ""
     enable_profiling: bool = True                # types.go:76
     enable_contention_profiling: bool = True
+    disable_preemption: bool = False             # types.go:85
     # extenders (reference: types.go:72 Extenders)
     extenders: List[Any] = field(default_factory=list)
     # TPU extensions

@@ -1,0 +1,254 @@
+"""Config decoding: versioned KubeSchedulerConfiguration YAML + legacy Policy.
+
+reference: cmd/kube-scheduler/app/options/configfile.go (loadConfigFromFile),
+pkg/scheduler/apis/config/v1beta1/defaults.go (defaulting),
+pkg/scheduler/apis/config/validation/validation.go,
+pkg/scheduler/apis/config/legacy_types.go + framework/plugins/
+legacy_registry.go (v1 Policy -> plugin translation, :493/:549).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+from .config import (DEFAULT_SCHEDULER_NAME, EXTENSION_POINTS,
+                     KubeSchedulerConfiguration, KubeSchedulerProfile, Plugin,
+                     PluginSet, Plugins)
+
+API_GROUP = "kubescheduler.config.k8s.io"
+SUPPORTED_VERSIONS = (f"{API_GROUP}/v1beta1", f"{API_GROUP}/v1alpha2")
+
+_EP_YAML_NAMES = {
+    "queueSort": "queue_sort", "preFilter": "pre_filter", "filter": "filter",
+    "preScore": "pre_score", "score": "score", "reserve": "reserve",
+    "permit": "permit", "preBind": "pre_bind", "bind": "bind",
+    "postBind": "post_bind", "unreserve": "unreserve",
+}
+
+
+class ConfigError(ValueError):
+    pass
+
+
+def load_config_file(path: str) -> KubeSchedulerConfiguration:
+    """reference: app/options/configfile.go:40 loadConfigFromFile."""
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    return load_config(doc)
+
+
+def load_config(doc: Dict[str, Any]) -> KubeSchedulerConfiguration:
+    if not isinstance(doc, dict):
+        raise ConfigError("config must be a mapping")
+    api_version = doc.get("apiVersion", "")
+    kind = doc.get("kind", "")
+    if kind and kind != "KubeSchedulerConfiguration":
+        raise ConfigError(f"unexpected kind {kind!r}")
+    if api_version and api_version not in SUPPORTED_VERSIONS:
+        raise ConfigError(f"unsupported apiVersion {api_version!r}; "
+                          f"supported: {SUPPORTED_VERSIONS}")
+    cfg = KubeSchedulerConfiguration()
+    cfg.percentage_of_nodes_to_score = doc.get("percentageOfNodesToScore", 0)
+    cfg.pod_initial_backoff_seconds = doc.get("podInitialBackoffSeconds", 1.0)
+    cfg.pod_max_backoff_seconds = doc.get("podMaxBackoffSeconds", 10.0)
+    cfg.disable_preemption = doc.get("disablePreemption", False)
+    le = doc.get("leaderElection", {}) or {}
+    cfg.leader_election = bool(le.get("leaderElect", False))
+    cfg.metrics_bind_address = doc.get("metricsBindAddress", "")
+    cfg.health_bind_address = doc.get("healthzBindAddress", "")
+    cfg.extenders = list(doc.get("extenders", []) or [])
+    cfg.batch_size = doc.get("batchSize", 256)  # TPU extension
+    cfg.profiles = [_decode_profile(p) for p in doc.get("profiles", [])]
+    apply_defaults(cfg)
+    validate(cfg)
+    return cfg
+
+
+def _decode_profile(doc: Dict[str, Any]) -> KubeSchedulerProfile:
+    prof = KubeSchedulerProfile(
+        scheduler_name=doc.get("schedulerName", DEFAULT_SCHEDULER_NAME))
+    plugins_doc = doc.get("plugins")
+    if plugins_doc:
+        plugins = Plugins()
+        for yaml_name, attr in _EP_YAML_NAMES.items():
+            ep = plugins_doc.get(yaml_name)
+            if not ep:
+                continue
+            ps = PluginSet(
+                enabled=[Plugin(p["name"], p.get("weight", 0))
+                         for p in ep.get("enabled", []) or []],
+                disabled=[Plugin(p["name"])
+                          for p in ep.get("disabled", []) or []])
+            setattr(plugins, attr, ps)
+        prof.plugins = plugins
+    for pc in doc.get("pluginConfig", []) or []:
+        prof.plugin_config[pc["name"]] = pc.get("args", {})
+    return prof
+
+
+def apply_defaults(cfg: KubeSchedulerConfiguration) -> None:
+    """reference: v1beta1/defaults.go SetDefaults_KubeSchedulerConfiguration."""
+    if not cfg.profiles:
+        cfg.profiles = [KubeSchedulerProfile()]
+    for p in cfg.profiles:
+        if not p.scheduler_name:
+            p.scheduler_name = DEFAULT_SCHEDULER_NAME
+    if cfg.batch_size <= 0:
+        cfg.batch_size = 256
+
+
+def validate(cfg: KubeSchedulerConfiguration) -> None:
+    """reference: validation/validation.go ValidateKubeSchedulerConfiguration."""
+    errs: List[str] = []
+    if not (0 <= cfg.percentage_of_nodes_to_score <= 100):
+        errs.append("percentageOfNodesToScore must be in [0, 100]")
+    if cfg.pod_initial_backoff_seconds <= 0:
+        errs.append("podInitialBackoffSeconds must be > 0")
+    if cfg.pod_max_backoff_seconds < cfg.pod_initial_backoff_seconds:
+        errs.append("podMaxBackoffSeconds must be >= podInitialBackoffSeconds")
+    names = [p.scheduler_name for p in cfg.profiles]
+    if len(set(names)) != len(names):
+        errs.append("duplicate scheduler name in profiles")
+    for p in cfg.profiles:
+        if p.plugins is None:
+            continue
+        for ep in EXTENSION_POINTS:
+            ps: PluginSet = getattr(p.plugins, ep)
+            for pl in ps.enabled:
+                if ep == "score" and pl.weight < 0:
+                    errs.append(f"plugin {pl.name}: negative weight")
+    if errs:
+        raise ConfigError("; ".join(errs))
+
+
+# ---------------------------------------------------------------------------
+# legacy v1 Policy (reference: legacy_types.go + legacy_registry.go)
+
+# predicate name -> filter plugins (reference: legacy_registry.go:146-241)
+_PREDICATE_TO_PLUGINS: Dict[str, List[str]] = {
+    "PodFitsResources": ["NodeResourcesFit"],
+    "PodFitsHostPorts": ["NodePorts"],
+    "HostName": ["NodeName"],
+    "MatchNodeSelector": ["NodeAffinity"],
+    "NoDiskConflict": ["VolumeRestrictions"],
+    "PodToleratesNodeTaints": ["TaintToleration"],
+    "CheckNodeUnschedulable": ["NodeUnschedulable"],
+    "CheckVolumeBinding": ["VolumeBinding"],
+    "NoVolumeZoneConflict": ["VolumeZone"],
+    "MaxCSIVolumeCountPred": ["NodeVolumeLimits"],
+    "MaxEBSVolumeCount": ["NodeVolumeLimits"],
+    "MaxGCEPDVolumeCount": ["NodeVolumeLimits"],
+    "MaxAzureDiskVolumeCount": ["NodeVolumeLimits"],
+    "MatchInterPodAffinity": ["InterPodAffinity"],
+    "EvenPodsSpreadPred": ["PodTopologySpread"],
+    "GeneralPredicates": ["NodeResourcesFit", "NodeName", "NodePorts",
+                          "NodeAffinity"],
+}
+
+# priority name -> (score plugin, also_pre_score)
+_PRIORITY_TO_PLUGIN: Dict[str, str] = {
+    "LeastRequestedPriority": "NodeResourcesLeastAllocated",
+    "MostRequestedPriority": "NodeResourcesMostAllocated",
+    "BalancedResourceAllocation": "NodeResourcesBalancedAllocation",
+    "SelectorSpreadPriority": "DefaultPodTopologySpread",
+    "InterPodAffinityPriority": "InterPodAffinity",
+    "NodeAffinityPriority": "NodeAffinity",
+    "TaintTolerationPriority": "TaintToleration",
+    "ImageLocalityPriority": "ImageLocality",
+    "NodePreferAvoidPodsPriority": "NodePreferAvoidPods",
+    "EvenPodsSpreadPriority": "PodTopologySpread",
+}
+
+# default predicate/priority sets when the Policy omits them
+# (reference: legacy_registry.go ApplyPredicatePolicy defaults)
+_DEFAULT_PREDICATES = ["CheckNodeUnschedulable", "GeneralPredicates",
+                      "PodToleratesNodeTaints", "NoDiskConflict",
+                      "CheckVolumeBinding", "NoVolumeZoneConflict",
+                      "MaxCSIVolumeCountPred", "MatchInterPodAffinity",
+                      "EvenPodsSpreadPred"]
+_DEFAULT_PRIORITIES = {"LeastRequestedPriority": 1,
+                       "BalancedResourceAllocation": 1,
+                       "NodePreferAvoidPodsPriority": 10000,
+                       "NodeAffinityPriority": 1,
+                       "TaintTolerationPriority": 1,
+                       "InterPodAffinityPriority": 1,
+                       "SelectorSpreadPriority": 1,
+                       "EvenPodsSpreadPriority": 2}
+
+_FILTER_ORDER = ["NodeUnschedulable", "NodeResourcesFit", "NodeName",
+                 "NodePorts", "NodeAffinity", "VolumeRestrictions",
+                 "TaintToleration", "NodeVolumeLimits", "VolumeBinding",
+                 "VolumeZone", "PodTopologySpread", "InterPodAffinity"]
+
+
+def load_policy(doc: Dict[str, Any]) -> KubeSchedulerConfiguration:
+    """Translate a v1 Policy into a single-profile configuration
+    (reference: scheduler.go:266-336 createFromConfig +
+    legacy_registry.go ProcessPredicatePolicy/ProcessPriorityPolicy)."""
+    if doc.get("kind") not in (None, "Policy"):
+        raise ConfigError(f"unexpected kind {doc.get('kind')!r}")
+    predicates = doc.get("predicates")
+    priorities = doc.get("priorities")
+
+    filter_names: List[str] = []
+    if predicates is None:
+        pred_names = list(_DEFAULT_PREDICATES)
+    else:
+        pred_names = [p["name"] for p in predicates]
+    for name in pred_names:
+        plugins = _PREDICATE_TO_PLUGINS.get(name)
+        if plugins is None:
+            raise ConfigError(f"unknown predicate {name!r}")
+        for pl in plugins:
+            if pl not in filter_names:
+                filter_names.append(pl)
+    filter_names.sort(key=lambda n: _FILTER_ORDER.index(n)
+                      if n in _FILTER_ORDER else 99)
+
+    score_weights: Dict[str, int] = {}
+    if priorities is None:
+        prio_items = list(_DEFAULT_PRIORITIES.items())
+    else:
+        prio_items = [(p["name"], p.get("weight", 1)) for p in priorities]
+    for name, weight in prio_items:
+        pl = _PRIORITY_TO_PLUGIN.get(name)
+        if pl is None:
+            raise ConfigError(f"unknown priority {name!r}")
+        score_weights[pl] = score_weights.get(pl, 0) + weight
+
+    star = [Plugin("*")]  # a Policy replaces the defaults wholesale
+    plugins = Plugins(
+        queue_sort=PluginSet(enabled=[Plugin("PrioritySort")], disabled=list(star)),
+        pre_filter=PluginSet(enabled=[
+            Plugin(n) for n in filter_names
+            if n in ("NodeResourcesFit", "NodePorts", "PodTopologySpread",
+                     "InterPodAffinity", "VolumeBinding")], disabled=list(star)),
+        filter=PluginSet(enabled=[Plugin(n) for n in filter_names],
+                         disabled=list(star)),
+        pre_score=PluginSet(disabled=list(star)),
+        score=PluginSet(enabled=[Plugin(n, w)
+                                 for n, w in score_weights.items()],
+                        disabled=list(star)),
+        reserve=PluginSet(enabled=[Plugin("VolumeBinding")]
+                          if "VolumeBinding" in filter_names else [],
+                          disabled=list(star)),
+        unreserve=PluginSet(enabled=[Plugin("VolumeBinding")]
+                            if "VolumeBinding" in filter_names else [],
+                            disabled=list(star)),
+        pre_bind=PluginSet(enabled=[Plugin("VolumeBinding")]
+                           if "VolumeBinding" in filter_names else [],
+                           disabled=list(star)),
+        post_bind=PluginSet(disabled=list(star)),
+        permit=PluginSet(disabled=list(star)),
+        bind=PluginSet(enabled=[Plugin("DefaultBinder")], disabled=list(star)),
+    )
+    prof = KubeSchedulerProfile(plugins=plugins)
+    if "hardPodAffinitySymmetricWeight" in doc:
+        prof.plugin_config["InterPodAffinity"] = {
+            "hardPodAffinityWeight": doc["hardPodAffinitySymmetricWeight"]}
+    cfg = KubeSchedulerConfiguration(profiles=[prof])
+    cfg.extenders = list(doc.get("extenders", []) or [])
+    validate(cfg)
+    return cfg

@@ -103,6 +103,16 @@ class Framework:
         self.hard_pod_affinity_weight = getattr(
             ipa, "hard_pod_affinity_weight", 1)
 
+    def tensor_plugin_args(self, table) -> Tuple[Tuple[str, Tuple], ...]:
+        """Resolve per-plugin static kernel args against the intern table
+        (e.g. NodeLabel key ids, RequestedToCapacityRatio shape)."""
+        out = []
+        for name, inst in self._instances.items():
+            ka = getattr(inst, "kernel_args", None)
+            if ka is not None and isinstance(inst, TensorPlugin):
+                out.append((name, ka(table)))
+        return tuple(out)
+
     def queue_sort_less(self, a, b) -> bool:
         # reference: framework.go:358 QueueSortFunc (exactly one plugin)
         return self.queue_sort_plugins[0].less(a, b)

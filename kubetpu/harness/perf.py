@@ -1,0 +1,311 @@
+"""scheduler_perf: YAML-driven scheduling benchmark harness.
+
+reference: test/integration/scheduler_perf/ — BenchmarkPerfScheduling
+(scheduler_perf_test.go:117) reads config/performance-config.yaml (15
+templated workloads), runs an in-process apiserver+scheduler
+(util.go:60-68), samples 1-second throughput and scheduler histograms
+(util.go:216-255) and emits perf-dashboard JSON DataItems
+(scheduler_perf_types.go).  This module is the TPU-native clone: the
+in-process ClusterStore plays the apiserver, hollow.make_* synthesize the
+fleet (kubemark analog), and the same JSON shape comes out.
+
+Run:  python -m kubetpu.harness.perf [--config config/performance-config.yaml]
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..api import types as api
+from ..apis.config import KubeSchedulerConfiguration, KubeSchedulerProfile
+from ..client.store import ClusterStore
+from ..scheduler import Scheduler
+from ..utils.metrics import SchedulerMetrics
+from . import hollow
+
+
+@dataclass
+class Workload:
+    """One benchmark case (reference: performance-config.yaml template +
+    params; scheduler_perf_test.go:64 testCase)."""
+    name: str
+    num_nodes: int = 100
+    num_init_pods: int = 0
+    num_pods_to_schedule: int = 100
+    # pod template features
+    pod_anti_affinity: bool = False          # required, hostname
+    pod_affinity: bool = False               # required, zone
+    preferred_pod_affinity: bool = False
+    preferred_pod_anti_affinity: bool = False
+    topology_spread: bool = False            # hard, zone
+    preferred_topology_spread: bool = False  # soft, zone
+    pvs: bool = False                        # one pre-bound PV/PVC per pod
+    group_labels: int = 10
+    zones: int = 8
+    batch_size: int = 256
+    # mixed mode: measured pods cycle through all enabled features
+    mixed: bool = False
+
+
+@dataclass
+class DataItem:
+    """reference: scheduler_perf_types.go DataItem."""
+    data: Dict[str, float]
+    unit: str
+    labels: Dict[str, str]
+
+    def to_doc(self):
+        return {"data": self.data, "unit": self.unit, "labels": self.labels}
+
+
+def _make_pod(w: Workload, i: int, prefix: str, store: ClusterStore) -> api.Pod:
+    p = hollow.make_pod(f"{prefix}-{i}", cpu_milli=100, mem=250 << 20,
+                        labels={"app": f"app-{i % w.group_labels}",
+                                "group": prefix})
+    features = []
+    if w.pod_anti_affinity:
+        features.append("anti")
+    if w.pod_affinity:
+        features.append("aff")
+    if w.preferred_pod_affinity:
+        features.append("paff")
+    if w.preferred_pod_anti_affinity:
+        features.append("panti")
+    if w.topology_spread:
+        features.append("spread")
+    if w.preferred_topology_spread:
+        features.append("pspread")
+    if w.pvs:
+        features.append("pv")
+    if w.mixed and features:
+        features = [features[i % len(features)]]
+    for f in features:
+        if f == "anti":
+            hollow.with_anti_affinity(p, api.LABEL_HOSTNAME,
+                                      match={"app": p.metadata.labels["app"]})
+        elif f == "aff":
+            hollow.with_affinity(p, api.LABEL_ZONE,
+                                 match={"group": prefix})
+            # seed pods must exist for required affinity to be satisfiable;
+            # the bootstrap rule covers the first pod per selector
+        elif f in ("paff", "panti"):
+            aff = p.spec.affinity or api.Affinity()
+            term = api.WeightedPodAffinityTerm(
+                weight=10,
+                pod_affinity_term=api.PodAffinityTerm(
+                    label_selector=api.LabelSelector(
+                        match_labels={"app": p.metadata.labels["app"]}),
+                    topology_key=api.LABEL_ZONE))
+            if f == "paff":
+                aff.pod_affinity = aff.pod_affinity or api.PodAffinity()
+                aff.pod_affinity.preferred_during_scheduling_ignored_during_execution.append(term)
+            else:
+                aff.pod_anti_affinity = aff.pod_anti_affinity or api.PodAntiAffinity()
+                aff.pod_anti_affinity.preferred_during_scheduling_ignored_during_execution.append(term)
+            p.spec.affinity = aff
+        elif f == "spread":
+            hollow.with_spread(p, api.LABEL_ZONE, max_skew=2,
+                               when="DoNotSchedule",
+                               match={"group": prefix})
+        elif f == "pspread":
+            hollow.with_spread(p, api.LABEL_ZONE, max_skew=1,
+                               when="ScheduleAnyway",
+                               match={"group": prefix})
+        elif f == "pv":
+            pv_name = f"pv-{prefix}-{i}"
+            pvc_name = f"pvc-{prefix}-{i}"
+            store.add(api.PersistentVolume(
+                metadata=api.ObjectMeta(name=pv_name),
+                storage_class_name="perf"))
+            store.add(api.PersistentVolumeClaim(
+                metadata=api.ObjectMeta(name=pvc_name),
+                storage_class_name="perf", volume_name=pv_name))
+            p.spec.volumes.append(api.Volume(
+                name="v", persistent_volume_claim=pvc_name))
+    return p
+
+
+class ThroughputCollector:
+    """1 Hz samples of pods scheduled per second
+    (reference: util.go:216 throughputCollector)."""
+
+    def __init__(self, store: ClusterStore, group: str):
+        self.store = store
+        self.group = group
+        self.samples: List[float] = []
+
+    def bound_count(self) -> int:
+        return sum(1 for p in self.store.list("Pod")
+                   if p.spec.node_name
+                   and p.metadata.labels.get("group") == self.group)
+
+    def run_until(self, target: int, timeout: float = 300.0,
+                  interval: float = 1.0) -> bool:
+        last = self.bound_count()
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            time.sleep(interval)
+            now = self.bound_count()
+            self.samples.append((now - last) / interval)
+            last = now
+            if now >= target:
+                return True
+        return False
+
+
+def _stats(samples: List[float]) -> Dict[str, float]:
+    if not samples:
+        return {"Average": 0.0, "Perc50": 0.0, "Perc90": 0.0, "Perc99": 0.0}
+    s = sorted(samples)
+
+    def perc(q):
+        import math
+        idx = min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))
+        return s[idx]
+    return {"Average": round(statistics.mean(s), 2),
+            "Perc50": round(perc(0.50), 2),
+            "Perc90": round(perc(0.90), 2),
+            "Perc99": round(perc(0.99), 2)}
+
+
+def run_workload(w: Workload, verbose: bool = False) -> List[DataItem]:
+    """reference: scheduler_perf_test.go:117 perfScheduling."""
+    store = ClusterStore()
+    for n in hollow.make_nodes(w.num_nodes, zones=w.zones):
+        store.add(n)
+    if w.pvs:
+        store.add(api.StorageClass(metadata=api.ObjectMeta(name="perf")))
+    metrics = SchedulerMetrics()
+    cfg = KubeSchedulerConfiguration(profiles=[KubeSchedulerProfile()],
+                                     batch_size=w.batch_size)
+    sched = Scheduler(store, config=cfg, metrics=metrics, async_binding=True)
+    thread = sched.run()
+    try:
+        # phase 1: init pods (not measured)
+        if w.num_init_pods:
+            for i in range(w.num_init_pods):
+                store.add(_make_pod(w, i, "init", store))
+            coll = ThroughputCollector(store, "init")
+            if not coll.run_until(w.num_init_pods):
+                raise RuntimeError(
+                    f"{w.name}: init pods did not schedule "
+                    f"({coll.bound_count()}/{w.num_init_pods})")
+        # phase 2: measured pods
+        for i in range(w.num_pods_to_schedule):
+            store.add(_make_pod(w, i, "measured", store))
+        coll = ThroughputCollector(store, "measured")
+        done = coll.run_until(w.num_pods_to_schedule)
+        sched.wait_for_inflight_binds()
+        scheduled = coll.bound_count()
+        if verbose:
+            print(f"  {w.name}: {scheduled}/{w.num_pods_to_schedule} "
+                  f"scheduled", flush=True)
+        items = [
+            DataItem(data=_stats(coll.samples), unit="pods/s",
+                     labels={"Name": w.name, "Metric": "SchedulingThroughput"}),
+        ]
+        for metric, hist in (
+                ("scheduling_algorithm_duration_seconds",
+                 metrics.scheduling_algorithm_duration),
+                ("binding_duration_seconds", metrics.binding_duration),
+                ("e2e_scheduling_duration_seconds",
+                 metrics.e2e_scheduling_duration),
+                ("pod_scheduling_duration_seconds",
+                 metrics.pod_scheduling_duration)):
+            n = hist.count()
+            items.append(DataItem(
+                data={"Average": round(hist.sum() / n, 6) if n else 0.0,
+                      "Perc50": hist.percentile(0.50),
+                      "Perc90": hist.percentile(0.90),
+                      "Perc99": hist.percentile(0.99)},
+                unit="s", labels={"Name": w.name, "Metric": metric}))
+        if not done:
+            items[0].labels["Incomplete"] = "true"
+        return items
+    finally:
+        sched.close()
+
+
+# the reference's workload matrix, scaled for one-box runs
+# (reference: config/performance-config.yaml:1-120)
+DEFAULT_WORKLOADS: List[Workload] = [
+    Workload(name="SchedulingBasic", num_nodes=100, num_init_pods=100,
+             num_pods_to_schedule=300),
+    Workload(name="SchedulingPodAntiAffinity", num_nodes=100,
+             num_init_pods=100, num_pods_to_schedule=150,
+             pod_anti_affinity=True, group_labels=100),
+    Workload(name="SchedulingPodAffinity", num_nodes=100, num_init_pods=100,
+             num_pods_to_schedule=300, pod_affinity=True),
+    Workload(name="SchedulingPreferredPodAffinity", num_nodes=100,
+             num_init_pods=100, num_pods_to_schedule=300,
+             preferred_pod_affinity=True),
+    Workload(name="SchedulingPreferredPodAntiAffinity", num_nodes=100,
+             num_init_pods=100, num_pods_to_schedule=300,
+             preferred_pod_anti_affinity=True),
+    Workload(name="TopologySpreading", num_nodes=100, num_init_pods=100,
+             num_pods_to_schedule=300, topology_spread=True),
+    Workload(name="PreferredTopologySpreading", num_nodes=100,
+             num_init_pods=100, num_pods_to_schedule=300,
+             preferred_topology_spread=True),
+    Workload(name="SchedulingInTreePVs", num_nodes=100, num_init_pods=50,
+             num_pods_to_schedule=100, pvs=True),
+    Workload(name="MixedSchedulingBasePod", num_nodes=100, num_init_pods=200,
+             num_pods_to_schedule=300, pod_anti_affinity=True,
+             pod_affinity=True, preferred_pod_affinity=True,
+             topology_spread=True, mixed=True),
+]
+
+
+def load_workloads(path: str) -> List[Workload]:
+    import yaml
+    with open(path) as f:
+        docs = yaml.safe_load(f)
+    if not isinstance(docs, list) or not all(isinstance(d, dict)
+                                             for d in docs):
+        raise SystemExit(f"{path}: expected a YAML list of workload "
+                         "mappings (see config/performance-config.yaml)")
+    out = []
+    for d in docs:
+        try:
+            out.append(Workload(**d))
+        except TypeError as e:
+            raise SystemExit(f"{path}: bad workload {d.get('name', d)}: {e}")
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default=None,
+                    help="YAML workload list (default: built-in matrix)")
+    ap.add_argument("--only", default=None, help="substring workload filter")
+    ap.add_argument("--out", default=None, help="write DataItems JSON here")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    workloads = (load_workloads(args.config) if args.config
+                 else DEFAULT_WORKLOADS)
+    if args.only:
+        workloads = [w for w in workloads if args.only.lower() in
+                     w.name.lower()]
+    all_items = []
+    for w in workloads:
+        if args.verbose:
+            print(f"running {w.name} ({w.num_nodes} nodes, "
+                  f"{w.num_pods_to_schedule} pods)...", flush=True)
+        items = run_workload(w, verbose=args.verbose)
+        all_items.extend(items)
+    doc = {"version": "v1",
+           "dataItems": [it.to_doc() for it in all_items]}
+    text = json.dumps(doc, indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+    print(text)
+
+
+if __name__ == "__main__":
+    main()

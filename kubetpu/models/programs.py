@@ -48,6 +48,7 @@ DEFAULT_FILTER_PLUGINS: Tuple[str, ...] = (
 # nodesWherePreemptionMightHelp, core/generic_scheduler.go:1041).
 UNRESOLVABLE_FILTERS = frozenset({
     "NodeUnschedulable", "NodeName", "NodeAffinity", "TaintToleration",
+    "NodeLabel",  # nodelabel/node_label.go:106 ErrReasonPresenceViolated
 })
 
 
@@ -56,6 +57,15 @@ class ProgramConfig(NamedTuple):
     filters: Tuple[str, ...] = DEFAULT_FILTER_PLUGINS
     scores: Tuple[Tuple[str, int], ...] = DEFAULT_SCORE_PLUGINS
     hostname_topokey: int = 0  # topokey vocab id of kubernetes.io/hostname
+    # per-plugin static kernel args, e.g. RequestedToCapacityRatio's shape
+    # or NodeLabel's resolved key ids: ((plugin, args-tuple), ...)
+    plugin_args: Tuple[Tuple[str, Tuple], ...] = ()
+
+    def arg(self, name: str, default=()):
+        for n, a in self.plugin_args:
+            if n == name:
+                return a
+        return default
 
 
 class FilterScoreResult(NamedTuple):
@@ -95,6 +105,9 @@ def run_filters(cluster, batch, cfg: ProgramConfig, host_ok=None):
         elif name == "InterPodAffinity":
             ok, aff_unres = K.interpod_filter(cluster, batch)
             unresolvable = unresolvable | (aff_unres & base)
+        elif name == "NodeLabel":
+            present, absent, _ = cfg.arg("NodeLabel", ((), (), ()))
+            ok = K.node_label_filter(cluster, batch, present, absent)
         else:
             raise ValueError(f"unknown filter kernel {name}")
         if name in UNRESOLVABLE_FILTERS:
@@ -133,6 +146,17 @@ def run_scores(cluster, batch, cfg: ProgramConfig, feasible, affinity_ok):
         elif name == "TaintToleration":
             s = K.default_normalize(K.taint_toleration_score(cluster, batch),
                                     feasible, reverse=True)
+        elif name == "RequestedToCapacityRatio":
+            shape, resources = cfg.arg(
+                "RequestedToCapacityRatio",
+                (((0, 0), (100, 10)), ((0, 0, 1), (1, 0, 1))))
+            s = K.requested_to_capacity_ratio_score(cluster, batch, shape,
+                                                    resources)
+        elif name == "NodeResourceLimits":
+            s = K.resource_limits_score(cluster, batch)
+        elif name == "NodeLabel":
+            _, _, prefs = cfg.arg("NodeLabel", ((), (), ()))
+            s = K.node_label_score(cluster, batch, prefs)
         else:
             raise ValueError(f"unknown score kernel {name}")
         s = jnp.where(feasible, s, 0.0) * float(weight)

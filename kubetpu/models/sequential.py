@@ -96,6 +96,10 @@ def schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
     apply_static("NodeName", K.node_name_filter(cluster, batch))
     apply_static("NodeAffinity", affinity_ok)
     apply_static("TaintToleration", K.taint_filter(cluster, batch))
+    if "NodeLabel" in filters:
+        nl_present, nl_absent, _ = cfg.arg("NodeLabel", ((), (), ()))
+        apply_static("NodeLabel",
+                     K.node_label_filter(cluster, batch, nl_present, nl_absent))
 
     ports_ok0 = K.node_ports_filter(cluster, batch) if "NodePorts" in filters else None
 
@@ -232,6 +236,14 @@ def schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
                     if "NodeAffinity" in score_w else None)
     taint_raw = (K.taint_toleration_score(cluster, batch)
                  if "TaintToleration" in score_w else None)
+    limits_score = (K.resource_limits_score(cluster, batch)
+                    if "NodeResourceLimits" in score_w else None)
+    nodelabel_score = (K.node_label_score(cluster, batch,
+                                          cfg.arg("NodeLabel", ((), (), ()))[2])
+                       if "NodeLabel" in score_w else None)
+    rtcr_args = (cfg.arg("RequestedToCapacityRatio",
+                         (((0, 0), (100, 10)), ((0, 0, 1), (1, 0, 1))))
+                 if "RequestedToCapacityRatio" in score_w else None)
 
     # ---------------- scan ----------------
     neg = jnp.float32(-2**62)
@@ -239,7 +251,7 @@ def schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
 
     def row_normalize(raw_row, feas_row, reverse):
         max_c = jnp.maximum(jnp.max(jnp.where(feas_row, raw_row, neg)), 0.0)
-        scaled = jnp.floor(K.MAX_NODE_SCORE * raw_row / jnp.maximum(max_c, 1.0))
+        scaled = K._idiv(K.MAX_NODE_SCORE * raw_row, jnp.maximum(max_c, 1.0))
         if reverse:
             scaled = K.MAX_NODE_SCORE - scaled
         zero_case = K.MAX_NODE_SCORE if reverse else 0.0
@@ -347,22 +359,43 @@ def schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
 
         if "NodeResourcesLeastAllocated" in score_w:
             def least(req, cap):
-                s = jnp.floor((cap - req) * K.MAX_NODE_SCORE / jnp.maximum(cap, 1.0))
+                s = K._idiv((cap - req) * K.MAX_NODE_SCORE, jnp.maximum(cap, 1.0))
                 return jnp.where((cap <= 0) | (req > cap), 0.0, s)
-            s = jnp.floor((least(req_cpu, alloc_cpu) + least(req_mem, alloc_mem)) / 2.0)
+            s = K._idiv(least(req_cpu, alloc_cpu) + least(req_mem, alloc_mem), 2.0)
             total += jnp.where(feas, s, 0.0) * score_w["NodeResourcesLeastAllocated"]
 
         if "NodeResourcesMostAllocated" in score_w:
             def most(req, cap):
-                s = jnp.floor(req * K.MAX_NODE_SCORE / jnp.maximum(cap, 1.0))
+                s = K._idiv(req * K.MAX_NODE_SCORE, jnp.maximum(cap, 1.0))
                 return jnp.where((cap <= 0) | (req > cap), 0.0, s)
-            s = jnp.floor((most(req_cpu, alloc_cpu) + most(req_mem, alloc_mem)) / 2.0)
+            s = K._idiv(most(req_cpu, alloc_cpu) + most(req_mem, alloc_mem), 2.0)
             total += jnp.where(feas, s, 0.0) * score_w["NodeResourcesMostAllocated"]
 
         if image_score is not None:
             total += jnp.where(feas, image_score[i], 0.0) * score_w["ImageLocality"]
         if avoid_score is not None:
             total += jnp.where(feas, avoid_score[i], 0.0) * score_w["NodePreferAvoidPods"]
+        if limits_score is not None:
+            total += jnp.where(feas, limits_score[i], 0.0) * score_w["NodeResourceLimits"]
+        if nodelabel_score is not None:
+            total += jnp.where(feas, nodelabel_score[i], 0.0) * score_w["NodeLabel"]
+        if rtcr_args is not None:
+            shape, resources = rtcr_args
+            parts = []
+            for kind, ch, weight in resources:
+                if kind == 0:
+                    req, cap = req_cpu, alloc_cpu
+                elif kind == 1:
+                    req, cap = req_mem, alloc_mem
+                elif ch < 0:
+                    req = jnp.zeros_like(req_cpu)
+                    cap = jnp.zeros_like(alloc_cpu)
+                else:
+                    cap = cluster.allocatable[:, ch]
+                    req = carry["req"][:, ch] + batch.req[i, ch]
+                parts.append((req, cap, weight))
+            rtcr = K.rtcr_combine(parts, shape)
+            total += jnp.where(feas, rtcr, 0.0) * score_w["RequestedToCapacityRatio"]
         if node_aff_raw is not None:
             total += row_normalize(node_aff_raw[i], feas, False) * score_w["NodeAffinity"]
         if taint_raw is not None:
@@ -378,8 +411,8 @@ def schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
             min_c = jnp.minimum(jnp.min(jnp.where(feas, raw, big)), 0.0)
             diff = max_c - min_c
             norm = jnp.where(diff > 0,
-                             jnp.floor(K.MAX_NODE_SCORE * (raw - min_c)
-                                       / jnp.maximum(diff, 1.0)), 0.0)
+                             K._idiv(K.MAX_NODE_SCORE * (raw - min_c),
+                                     jnp.maximum(diff, 1.0)), 0.0)
             s = jnp.where(any_counts, norm, raw)
             total += jnp.where(feas, s, 0.0) * score_w["InterPodAffinity"]
 
@@ -414,9 +447,9 @@ def schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
             min_s = jnp.min(jnp.where(scored, raw, big))
             max_s = jnp.maximum(jnp.max(jnp.where(scored, raw, neg)), 0.0)
             norm = jnp.where(max_s > 0,
-                             jnp.floor(K.MAX_NODE_SCORE * (max_s + jnp.minimum(min_s, big)
-                                                           - raw)
-                                       / jnp.maximum(max_s, 1.0)),
+                             K._idiv(K.MAX_NODE_SCORE * (max_s + jnp.minimum(min_s, big)
+                                                         - raw),
+                                     jnp.maximum(max_s, 1.0)),
                              K.MAX_NODE_SCORE)
             s = jnp.where(ignored, 0.0, norm)
             s = jnp.where(jnp.any(valid), s, K.MAX_NODE_SCORE)

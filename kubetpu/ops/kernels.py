@@ -30,8 +30,15 @@ def _f(x):
 
 def _idiv(a, b):
     """Go int64 division (truncation toward zero) for non-negative operands;
-    b == 0 guarded by callers."""
-    return jnp.floor(a / b)
+    b == 0 guarded by callers.
+
+    floor(a / b) alone is WRONG under XLA on TPU: fast-math lowers x/b to
+    x * (1/b), and e.g. 200 * (1/100) = 1.9999999 floors to 1.  Both
+    operands here are exact integers in f32 range, so one remainder
+    correction recovers the exact quotient."""
+    q = jnp.floor(a / b)
+    r = a - q * b
+    return q + jnp.where(r >= b, 1.0, 0.0) - jnp.where(r < 0, 1.0, 0.0)
 
 
 # ---------------------------------------------------------------------------
@@ -272,8 +279,8 @@ def spread_soft_score(cluster, batch, feasible, affinity_ok,
     max_s = jnp.max(jnp.where(sel, raw, -big), axis=1, keepdims=True)
     max_s = jnp.maximum(max_s, 0.0)
     norm = jnp.where(max_s > 0,
-                     jnp.floor(MAX_NODE_SCORE * (max_s + jnp.minimum(min_s, big)
-                                                 - raw) / jnp.maximum(max_s, 1.0)),
+                     _idiv(MAX_NODE_SCORE * (max_s + jnp.minimum(min_s, big)
+                                             - raw), jnp.maximum(max_s, 1.0)),
                      MAX_NODE_SCORE)
     out = jnp.where(ignored, 0.0, norm)
     # no soft constraints => every filtered node scores MaxNodeScore (the
@@ -401,8 +408,8 @@ def interpod_score(cluster, batch, feasible) -> jnp.ndarray:
                                 keepdims=True), 0.0)
     diff = max_c - min_c
     norm = jnp.where(diff > 0,
-                     jnp.floor(MAX_NODE_SCORE * (raw - min_c)
-                               / jnp.maximum(diff, 1.0)),
+                     _idiv(MAX_NODE_SCORE * (raw - min_c),
+                           jnp.maximum(diff, 1.0)),
                      0.0)
     out = jnp.where(any_counts, norm, raw)
     return jnp.where(feasible, out, 0.0)
@@ -572,3 +579,124 @@ def default_normalize(raw, feasible, reverse: bool) -> jnp.ndarray:
     zero_case = MAX_NODE_SCORE if reverse else 0.0
     out = jnp.where(max_c > 0, scaled, zero_case)
     return jnp.where(feasible, out, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# configurable scorers (plugin-args driven)
+
+
+def _itrunc(a, b):
+    """Go int64 division truncates toward ZERO (not floor); b > 0."""
+    q = _idiv(jnp.abs(a), b)
+    return jnp.where(a < 0, -q, q)
+
+
+def broken_linear(p, shape):
+    """Piecewise-linear shape function with Go integer-division semantics
+    (reference: noderesources/requested_to_capacity_ratio.go:158
+    buildBrokenLinearFunction).  shape: static tuple of (utilization, score).
+    Decreasing segments produce negative deltas, so the division must
+    truncate toward zero like Go's, not floor."""
+    out = jnp.full_like(p, float(shape[-1][1]))
+    for i in range(len(shape) - 1, -1, -1):
+        u_i, s_i = float(shape[i][0]), float(shape[i][1])
+        if i == 0:
+            seg = jnp.full_like(p, s_i)
+        else:
+            u_p, s_p = float(shape[i - 1][0]), float(shape[i - 1][1])
+            seg = s_p + _itrunc((s_i - s_p) * (p - u_p), u_i - u_p)
+        out = jnp.where(p <= u_i, seg, out)
+    return out
+
+
+def rtcr_combine(parts, shape):
+    """Weighted RequestedToCapacityRatio combine shared by the batch kernel
+    and the sequential scan (reference: requested_to_capacity_ratio.go:
+    124-147).  parts: iterable of (req, cap, weight) arrays; zero/exceeded
+    capacity falls back to rawScoringFunction(maxUtilization); the final
+    divide is math.Round (half away from zero) in exact integer form."""
+    total = None
+    weight_sum = None
+    for req, cap, weight in parts:
+        util = 100.0 - _idiv((cap - req) * 100.0, jnp.maximum(cap, 1.0))
+        s = broken_linear(util, shape)
+        s = jnp.where((cap <= 0) | (req > cap),
+                      broken_linear(jnp.full_like(util, 100.0), shape), s)
+        contrib = jnp.where(s > 0, s * weight, 0.0)
+        w = jnp.where(s > 0, float(weight), 0.0)
+        total = contrib if total is None else total + contrib
+        weight_sum = w if weight_sum is None else weight_sum + w
+    return jnp.where(weight_sum > 0,
+                     _idiv(2.0 * total + weight_sum,
+                           jnp.maximum(2.0 * weight_sum, 1.0)),
+                     0.0)
+
+
+def requested_to_capacity_ratio_score(cluster, batch, shape, resources) -> jnp.ndarray:
+    """RequestedToCapacityRatio (reference: requested_to_capacity_ratio.go:
+    124-147).  shape: ((utilization, score)...); resources: ((kind, ch,
+    weight)...) with kind 0=cpu (NonZero), 1=memory (NonZero), 2=scalar
+    channel ch.  Scores use math.Round (half away from zero)."""
+    req_cpu, req_mem, alloc_cpu, alloc_mem = _alloc_req(cluster, batch)
+    parts = []
+    for kind, ch, weight in resources:
+        if kind == 0:
+            req, cap = req_cpu, alloc_cpu
+        elif kind == 1:
+            req, cap = req_mem, alloc_mem
+        elif ch < 0:
+            # resource name unknown to the cluster: capacity 0 everywhere
+            # (Go falls to rawScoringFunction(maxUtilization))
+            req = jnp.zeros_like(req_cpu)
+            cap = jnp.zeros_like(alloc_cpu)
+        else:
+            cap = cluster.allocatable[None, :, ch]
+            req = cluster.requested[None, :, ch] + batch.req[:, ch][:, None]
+        parts.append((req, cap, weight))
+    return rtcr_combine(parts, shape)
+
+
+def resource_limits_score(cluster, batch) -> jnp.ndarray:
+    """NodeResourceLimits: 1 if the node satisfies the pod's cpu or memory
+    *limit* (reference: noderesources/resource_limits.go:104-123,155)."""
+    lim_cpu = batch.limits[:, None, CH_CPU]
+    lim_mem = batch.limits[:, None, CH_MEM]
+    alloc_cpu = cluster.allocatable[None, :, CH_CPU]
+    alloc_mem = cluster.allocatable[None, :, CH_MEM]
+    cpu_ok = (lim_cpu > 0) & (alloc_cpu > 0) & (lim_cpu <= alloc_cpu)
+    mem_ok = (lim_mem > 0) & (alloc_mem > 0) & (lim_mem <= alloc_mem)
+    return jnp.where(cpu_ok | mem_ok, 1.0, 0.0)
+
+
+def node_label_filter(cluster, batch, present_ids, absent_ids) -> jnp.ndarray:
+    """NodeLabel filter: all configured present labels present, absent ones
+    absent (reference: nodelabel/node_label.go:48-68).  ids are key-vocab
+    ids; -1 means the label exists nowhere in the cluster."""
+    B = batch.req.shape[0]
+    N = cluster.keymask.shape[0]
+    ok = jnp.ones((N,), bool)
+    for kid in present_ids:
+        ok = ok & (cluster.keymask[:, kid] if kid >= 0
+                   else jnp.zeros((N,), bool))
+    for kid in absent_ids:
+        ok = ok & (~cluster.keymask[:, kid] if kid >= 0
+                   else jnp.ones((N,), bool))
+    return jnp.broadcast_to(ok[None, :], (B, N))
+
+
+def node_label_score(cluster, batch, prefs) -> jnp.ndarray:
+    """NodeLabel score: average of MaxNodeScore per satisfied preference
+    (reference: nodelabel/node_label.go:70-93).  prefs: ((key_id,
+    want_present)...)."""
+    B = batch.req.shape[0]
+    N = cluster.keymask.shape[0]
+    if not prefs:
+        return jnp.zeros((B, N), jnp.float32)
+    score = jnp.zeros((N,), jnp.float32)
+    for kid, want_present in prefs:
+        has = (cluster.keymask[:, kid] if kid >= 0
+               else jnp.zeros((N,), bool))
+        hit = has if want_present else ~has
+        score = score + jnp.where(hit, MAX_NODE_SCORE, 0.0)
+    score = _idiv(score, float(len(prefs)))
+    return jnp.broadcast_to(score[None, :], (B, N))

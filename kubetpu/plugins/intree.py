@@ -127,6 +127,158 @@ class ImageLocality(TensorPlugin, fw.ScorePlugin):
     SCORE_KERNEL = "ImageLocality"
 
 
+class RequestedToCapacityRatio(TensorPlugin, fw.ScorePlugin):
+    """User-shaped bin-packing scorer
+    (reference: noderesources/requested_to_capacity_ratio.go)."""
+    NAME = "RequestedToCapacityRatio"
+    SCORE_KERNEL = "RequestedToCapacityRatio"
+
+    def __init__(self, args=None):
+        args = args or {}
+        shape = args.get("shape") or [{"utilization": 0, "score": 0},
+                                      {"utilization": 100, "score": 10}]
+        self.shape = tuple((int(p["utilization"]), int(p["score"]))
+                           for p in shape)
+        self.resources = [(r["name"], int(r.get("weight", 1)))
+                          for r in args.get("resources")
+                          or [{"name": "cpu", "weight": 1},
+                              {"name": "memory", "weight": 1}]]
+
+    def kernel_args(self, table) -> tuple:
+        from ..state.tensors import N_FIXED_CHANNELS
+        resolved = []
+        for name, weight in self.resources:
+            if name == "cpu":
+                resolved.append((0, 0, weight))
+            elif name == "memory":
+                resolved.append((1, 0, weight))
+            else:
+                ch = table.rname.get(name)
+                resolved.append((2, N_FIXED_CHANNELS + max(ch, 0), weight))
+        return (self.shape, tuple(resolved))
+
+
+class NodeResourceLimits(TensorPlugin, fw.PreScorePlugin, fw.ScorePlugin):
+    """reference: noderesources/resource_limits.go."""
+    NAME = "NodeResourceLimits"
+    SCORE_KERNEL = "NodeResourceLimits"
+
+
+class NodeLabel(TensorPlugin, fw.FilterPlugin, fw.ScorePlugin):
+    """Configured label presence/absence (legacy)
+    (reference: nodelabel/node_label.go)."""
+    NAME = "NodeLabel"
+    FILTER_KERNEL = "NodeLabel"
+    SCORE_KERNEL = "NodeLabel"
+
+    def __init__(self, args=None):
+        args = args or {}
+        self.present = list(args.get("presentLabels", []))
+        self.absent = list(args.get("absentLabels", []))
+        self.present_pref = list(args.get("presentLabelsPreference", []))
+        self.absent_pref = list(args.get("absentLabelsPreference", []))
+
+    def kernel_args(self, table) -> tuple:
+        prefs = tuple([(table.key.get(l), True) for l in self.present_pref]
+                      + [(table.key.get(l), False) for l in self.absent_pref])
+        return (tuple(table.key.get(l) for l in self.present),
+                tuple(table.key.get(l) for l in self.absent),
+                prefs)
+
+
+class ServiceAffinity(fw.PreFilterPlugin, fw.FilterPlugin, fw.ScorePlugin):
+    """Legacy host plugin: co-locate a service's pods on nodes with equal
+    values for the configured labels (reference:
+    serviceaffinity/service_affinity.go:428).  Host-side because it is
+    legacy, rarely enabled, and service-membership-driven."""
+    NAME = "ServiceAffinity"
+    STATE_KEY = "PreFilterServiceAffinity"
+
+    def __init__(self, store=None, args=None):
+        self.store = store
+        args = args or {}
+        self.affinity_labels = list(args.get("affinityLabels", []))
+        self.antiaffinity_labels = list(
+            args.get("antiAffinityLabelsPreference", []))
+
+    def relevant(self, pod) -> bool:
+        return bool(self.affinity_labels or self.antiaffinity_labels)
+
+    def _matching_pods(self, pod):
+        """Pods of the same service(s), cluster-wide, deduplicated across
+        services (reference: service_affinity.go:169 createPreFilterState)."""
+        if self.store is None:
+            return []
+        seen = set()
+        out = []
+        for svc in self.store.list("Service"):
+            if svc.metadata.namespace != pod.namespace or not svc.selector:
+                continue
+            if all(pod.metadata.labels.get(k) == v
+                   for k, v in svc.selector.items()):
+                for other in self.store.list("Pod"):
+                    if (other.uid not in seen
+                            and other.namespace == pod.namespace
+                            and other.spec.node_name
+                            and all(other.metadata.labels.get(k) == v
+                                    for k, v in svc.selector.items())):
+                        seen.add(other.uid)
+                        out.append(other)
+        return out
+
+    def pre_filter(self, state, pod) -> Status:
+        state.write(self.STATE_KEY, self._matching_pods(pod))
+        return Status.success()
+
+    def filter(self, state, pod, node_info) -> Status:
+        # reference: service_affinity.go:214 Filter — the node must carry the
+        # same values for the affinity labels as the service's other pods'
+        # nodes (derived from any one matching pod's node)
+        if not self.affinity_labels:
+            return Status.success()
+        try:
+            matching = state.read(self.STATE_KEY)
+        except KeyError:
+            matching = self._matching_pods(pod)
+        node = node_info.node
+        wanted = {}
+        for other in matching:
+            other_node = (self.store.get_node(other.spec.node_name)
+                          if self.store else None)
+            if other_node is None:
+                continue
+            for lab in self.affinity_labels:
+                if lab in other_node.metadata.labels:
+                    wanted[lab] = other_node.metadata.labels[lab]
+        for lab, val in wanted.items():
+            if node.metadata.labels.get(lab) != val:
+                return Status.unschedulable(
+                    "node(s) didn't match service affinity")
+        return Status.success()
+
+    def score(self, state, pod, node_name):
+        # reference: service_affinity.go:259 Score — count of matching pods
+        # on the node (normalized zone-aware upstream; simple count here).
+        # Reuses the PreFilter state rather than rescanning per node.
+        try:
+            matching = state.read(self.STATE_KEY)
+        except KeyError:
+            matching = self._matching_pods(pod)
+            state.write(self.STATE_KEY, matching)
+        count = sum(1 for p in matching if p.spec.node_name == node_name)
+        return count, Status.success()
+
+    def score_extensions(self):
+        return self
+
+    def normalize_score(self, state, pod, scores):
+        max_c = max((s for _, s in scores), default=0)
+        if max_c == 0:
+            return [(n, 0) for n, _ in scores], Status.success()
+        return [(n, int(fw.MAX_NODE_SCORE * s / max_c))
+                for n, s in scores], Status.success()
+
+
 # ---------------------------------------------------------------------------
 # host-side plugins (volume family is fleshed out in kubetpu/plugins/volumes.py)
 
@@ -180,6 +332,13 @@ def new_in_tree_registry() -> Registry:
         DefaultPodTopologySpread.NAME:
             lambda args=None, handle=None: DefaultPodTopologySpread(),
         ImageLocality.NAME: lambda args=None, handle=None: ImageLocality(),
+        RequestedToCapacityRatio.NAME:
+            lambda args=None, handle=None: RequestedToCapacityRatio(args),
+        NodeResourceLimits.NAME:
+            lambda args=None, handle=None: NodeResourceLimits(),
+        NodeLabel.NAME: lambda args=None, handle=None: NodeLabel(args),
+        ServiceAffinity.NAME: lambda args=None, handle=None: ServiceAffinity(
+            store=handle.client if handle else None, args=args),
         DefaultBinder.NAME: lambda args=None, handle=None: DefaultBinder(
             client=handle.client if handle else None),
         volumes.VolumeBinding.NAME:

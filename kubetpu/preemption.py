@@ -1,0 +1,312 @@
+"""Preemption: batched what-if victim selection.
+
+reference: pkg/scheduler/core/generic_scheduler.go — Preempt :252,
+podEligibleToPreemptOthers :1063, nodesWherePreemptionMightHelp :1041,
+selectNodesForPreemption :858, selectVictimsOnNode :949 (clone + remove
+lower-priority pods + re-run filters + reprieve by PDB then priority),
+pickOneNodeForPreemption :729 (6-criteria lexicographic tie-break),
+getLowerPriorityNominatedPods :360; invoked from scheduler.go:391 preempt.
+
+TPU shape of the what-if: the reference clones one NodeInfo per candidate
+and re-runs all filter plugins against it.  Here the clone is a *mask
+flip*: victims are existing-pod rows in the already-built cluster tensors,
+so "remove the victims of node n" = clear their pod_valid bits and subtract
+their resource rows — then ONE jitted filter pass answers "does the pod now
+fit on n".  The candidate scan batches those passes; the data-dependent
+reprieve loop (:1004-1037) stays host-side, exactly as SURVEY.md §7 planned.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .api import types as api
+from .framework.interface import CycleState
+from .framework.types import NodeInfo, PodInfo
+from .models import programs
+from .models.batch import PodBatchBuilder
+from .state.tensors import MIB, CH_PODS, SnapshotBuilder
+
+
+class Victims:
+    __slots__ = ("pods", "num_pdb_violations")
+
+    def __init__(self, pods: List[api.Pod], num_pdb_violations: int):
+        self.pods = pods
+        self.num_pdb_violations = num_pdb_violations
+
+
+class Preemptor:
+    def __init__(self, scheduler, max_detailed_candidates: int = 16):
+        self.sched = scheduler
+        self.max_detailed_candidates = max_detailed_candidates
+
+    # ------------------------------------------------------------------ entry
+
+    def preempt(self, fwk, state: CycleState, pod: api.Pod) -> Optional[str]:
+        """reference: scheduler.go:391 + generic_scheduler.go:252 Preempt.
+        Returns the nominated node name, or None."""
+        sched = self.sched
+        pod = sched.store.get_pod(pod.namespace, pod.metadata.name) or pod
+        if not self._eligible(pod):
+            return None
+        sched.cache.update_snapshot(sched.snapshot)
+        node_infos = sched.snapshot.node_info_list
+        if not node_infos:
+            return None
+
+        cand = self._nodes_where_preemption_might_help(fwk, pod, node_infos)
+        if not cand:
+            return None
+        pdbs = sched.store.list("PodDisruptionBudget")
+        node_victims = self._select_nodes_for_preemption(fwk, pod, cand, pdbs)
+        if not node_victims:
+            return None
+        best = pick_one_node_for_preemption(node_victims)
+        if best is None:
+            return None
+
+        victims = node_victims[best]
+        for victim in victims.pods:
+            # delete victims via the API (reference: scheduler.go:403-415)
+            try:
+                sched.store.delete(victim)
+            except Exception:
+                pass
+            if sched.recorder:
+                sched.recorder.event(victim, "Normal", "Preempted",
+                                     f"by {pod.namespace}/{pod.metadata.name} "
+                                     f"on node {best}")
+        # reject lower-priority waiting (Permit) pods on the node
+        def maybe_reject(wp):
+            if (wp.pod.priority() < pod.priority()):
+                wp.reject("preempted")
+        fwk.iterate_over_waiting_pods(maybe_reject)
+        # clear nomination of lower-priority pods nominated to this node
+        for np_ in sched.queue.nominated_pods_for_node(best):
+            if np_.priority() < pod.priority():
+                sched.queue.delete_nominated_pod_if_exists(np_)
+        sched.queue.add_nominated_pod(pod, best)
+        return best
+
+    def _eligible(self, pod: api.Pod) -> bool:
+        """reference: generic_scheduler.go:1063 podEligibleToPreemptOthers —
+        if the pod already nominated a node and a lower-priority pod there
+        is terminating, wait instead of preempting again."""
+        nominated = pod.status.nominated_node_name
+        if not nominated:
+            return True
+        ni = self.sched.snapshot.get(nominated)
+        if ni is None:
+            return True
+        for pi in ni.pods:
+            if (pi.pod.metadata.deletion_timestamp is not None
+                    and pi.pod.priority() < pod.priority()):
+                return False
+        return True
+
+    # ------------------------------------------------------- candidate nodes
+
+    def _nodes_where_preemption_might_help(self, fwk, pod: api.Pod,
+                                           node_infos: Sequence[NodeInfo]):
+        """reference: generic_scheduler.go:1041 — skip nodes whose failure
+        was UnschedulableAndUnresolvable.  One device pass recovers the
+        per-node unresolvable verdicts."""
+        import jax
+        builder = SnapshotBuilder(
+            hard_pod_affinity_weight=fwk.hard_pod_affinity_weight)
+        pinfos = [PodInfo(pod)]
+        builder.intern_pending(pinfos)
+        host = builder.build(list(node_infos))
+        cluster = host.to_device()
+        pb = PodBatchBuilder(builder.table)
+        batch = jax.tree.map(np.asarray, pb.build(
+            pinfos,
+            spread_selectors=[self.sched.store.default_spread_selector(pod)]))
+        cfg = programs.ProgramConfig(
+            filters=fwk.tensor_filters, scores=fwk.tensor_scores,
+            hostname_topokey=max(
+                builder.table.topokey.get(api.LABEL_HOSTNAME), 0),
+            plugin_args=fwk.tensor_plugin_args(builder.table))
+        res = programs.filter_and_score(cluster, batch, cfg)
+        feasible = np.asarray(res.feasible)[0, :len(node_infos)]
+        unresolvable = np.asarray(res.unresolvable)[0, :len(node_infos)]
+        self._sim = (builder, host, pinfos, batch, cfg)  # reused by the sim
+        return [ni for ni, f, u in zip(node_infos, feasible, unresolvable)
+                if not f and not u]
+
+    # -------------------------------------------------------- victim search
+
+    def _select_nodes_for_preemption(self, fwk, pod: api.Pod,
+                                     candidates: Sequence[NodeInfo],
+                                     pdbs) -> Dict[str, Victims]:
+        """reference: generic_scheduler.go:858 (parallel what-if).  Ranks
+        candidates by cheap host-side stats, then runs the detailed
+        (device-checked) simulation on the strongest few."""
+        prio = pod.priority()
+        with_victims = []
+        for ni in candidates:
+            lower = [pi.pod for pi in ni.pods if pi.pod.priority() < prio]
+            if not lower:
+                continue
+            with_victims.append((ni, lower))
+        # cheap pre-rank approximating pickOneNode's criteria so the
+        # detailed cap keeps the likely winners
+        def rank(item):
+            ni, lower = item
+            return (max(p.priority() for p in lower),
+                    sum(p.priority() for p in lower), len(lower))
+        with_victims.sort(key=rank)
+        out: Dict[str, Victims] = {}
+        for ni, lower in with_victims[: self.max_detailed_candidates]:
+            v = self._select_victims_on_node(fwk, pod, ni, lower, pdbs)
+            if v is not None:
+                out[ni.node_name] = v
+        return out
+
+    def _select_victims_on_node(self, fwk, pod: api.Pod, ni: NodeInfo,
+                                lower: List[api.Pod], pdbs) -> Optional[Victims]:
+        """reference: generic_scheduler.go:949 selectVictimsOnNode."""
+        node_row = self._node_row(ni)
+        removed = set(p.uid for p in lower)
+        if not self._fits(fwk, pod, ni, node_row, removed):
+            return None
+        violating, non_violating = filter_pods_with_pdb_violation(lower, pdbs)
+
+        victims: List[api.Pod] = []
+        num_violating = 0
+
+        def reprieve(p: api.Pod) -> bool:
+            # try adding p back; keep it if the pod still fits
+            removed.discard(p.uid)
+            if self._fits(fwk, pod, ni, node_row, removed):
+                return True
+            removed.add(p.uid)
+            victims.append(p)
+            return False
+
+        # reprieve in priority order, PDB-violating pods first
+        # (reference: :1004-1037)
+        for p in sorted(violating, key=lambda x: -x.priority()):
+            if not reprieve(p):
+                num_violating += 1
+        for p in sorted(non_violating, key=lambda x: -x.priority()):
+            reprieve(p)
+        return Victims(pods=victims, num_pdb_violations=num_violating)
+
+    # ------------------------------------------------------- device what-if
+
+    def _node_row(self, ni: NodeInfo) -> int:
+        for i, other in enumerate(self.sched.snapshot.node_info_list):
+            if other.node_name == ni.node_name:
+                return i
+        raise KeyError(ni.node_name)
+
+    def _fits(self, fwk, pod: api.Pod, ni: NodeInfo, node_row: int,
+              removed_uids: set) -> bool:
+        """Does `pod` pass all tensor filters on node `node_row` with the
+        given pods removed?  One B=1 jitted pass over mask-flipped tensors
+        (the clone-free NodeInfo.Clone of generic_scheduler.go:871)."""
+        import jax
+        builder, host, pinfos, batch, cfg = self._sim
+        d = dict(host.arrays)
+        pod_valid = d["pod_valid"].copy()
+        req = d["requested"].copy()
+        nz = d["nonzero_requested"].copy()
+        # find victim rows: existing pods of this node with removed uids
+        row = 0
+        for n_idx, ninfo in enumerate(self.sched.snapshot.node_info_list):
+            for pi in ninfo.pods:
+                if n_idx == node_row and pi.pod.uid in removed_uids:
+                    pod_valid[row] = False
+                    r = pi.resource
+                    req[node_row, 0] -= r.milli_cpu
+                    req[node_row, 1] -= r.memory / MIB
+                    req[node_row, 2] -= r.ephemeral_storage / MIB
+                    req[node_row, CH_PODS] -= 1
+                    nz[node_row, 0] -= pi.non_zero_cpu
+                    nz[node_row, 1] -= pi.non_zero_mem / MIB
+                row += 1
+        d["pod_valid"] = pod_valid
+        d["requested"] = req
+        d["nonzero_requested"] = nz
+        from .state.tensors import HostClusterArrays
+        cluster = HostClusterArrays(arrays=d).to_device()
+        # host filters must also pass on the victim-adjusted node
+        if fwk.has_relevant_host_filters(pod):
+            sim_ni = ni.clone()
+            for pi in list(sim_ni.pods):
+                if pi.pod.uid in removed_uids:
+                    sim_ni.remove_pod(pi.pod)
+            st = fwk.run_filter_plugins(CycleState(), pod, sim_ni)
+            if not st.is_success():
+                return False
+        res = programs.filter_and_score(cluster, batch, cfg)
+        return bool(np.asarray(res.feasible)[0, node_row])
+
+
+# ---------------------------------------------------------------------------
+# pure functions (host)
+
+
+def filter_pods_with_pdb_violation(pods: List[api.Pod],
+                                   pdbs) -> Tuple[List[api.Pod], List[api.Pod]]:
+    """reference: generic_scheduler.go:1118 filterPodsWithPDBViolation."""
+    violating, non_violating = [], []
+    remaining = {id(pdb): pdb.disruptions_allowed for pdb in pdbs}
+    for p in pods:
+        hit = False
+        for pdb in pdbs:
+            if pdb.metadata.namespace != p.namespace:
+                continue
+            if pdb.selector is not None and pdb.selector.matches(
+                    p.metadata.labels):
+                if remaining[id(pdb)] <= 0:
+                    hit = True
+                else:
+                    remaining[id(pdb)] -= 1
+        (violating if hit else non_violating).append(p)
+    return violating, non_violating
+
+
+def pick_one_node_for_preemption(node_victims: Dict[str, Victims]) -> Optional[str]:
+    """reference: generic_scheduler.go:729 — lexicographic tie-break:
+    1. fewest PDB violations
+    2. lowest highest-victim-priority
+    3. lowest sum of victim priorities
+    4. fewest victims
+    5. latest earliest start time of highest-priority victim
+    6. first in iteration order (reference returns the first remaining)."""
+    if not node_victims:
+        return None
+    nodes = list(node_victims)
+
+    def metric(fns):
+        nonlocal nodes
+        vals = {n: fns(node_victims[n]) for n in nodes}
+        best = min(vals.values())
+        nodes = [n for n in nodes if vals[n] == best]
+
+    metric(lambda v: v.num_pdb_violations)
+    if len(nodes) == 1:
+        return nodes[0]
+    metric(lambda v: max((p.priority() for p in v.pods), default=-2**31))
+    if len(nodes) == 1:
+        return nodes[0]
+    metric(lambda v: sum(p.priority() for p in v.pods))
+    if len(nodes) == 1:
+        return nodes[0]
+    metric(lambda v: len(v.pods))
+    if len(nodes) == 1:
+        return nodes[0]
+    # latest start time of the highest-priority victim (max => min of -ts)
+    def neg_latest_start(v: Victims):
+        if not v.pods:
+            return 0.0
+        top = max(v.pods, key=lambda p: p.priority())
+        return -top.metadata.creation_timestamp
+    metric(neg_latest_start)
+    return nodes[0]

@@ -44,6 +44,7 @@ from .plugins.intree import new_in_tree_registry
 from .schedqueue.queue import SchedulingQueue
 from .state.cache import SchedulerCache, Snapshot
 from .state.tensors import SnapshotBuilder
+from .utils.trace import Trace
 
 
 @dataclass
@@ -79,6 +80,9 @@ class Scheduler:
             self.profiles[prof.scheduler_name] = Framework(
                 registry, prof, client=store, metrics=metrics)
 
+        from .extender import HTTPExtender
+        self.extenders = [HTTPExtender(e) for e in self.config.extenders]
+
         any_fw = next(iter(self.profiles.values()))
         self.queue = SchedulingQueue(
             sort_key=any_fw.queue_sort_key,
@@ -94,7 +98,13 @@ class Scheduler:
         self._inflight_binds: List = []
         self._stop = threading.Event()
         self._add_all_event_handlers()
-        self.preemptor = None  # attached by kubetpu.preemption
+        # reference: scheduler.go:548 — preemption runs unless disabled
+        # (DisablePreemption componentconfig field)
+        if getattr(self.config, "disable_preemption", False):
+            self.preemptor = None
+        else:
+            from .preemption import Preemptor
+            self.preemptor = Preemptor(self)
 
     # ------------------------------------------------------------------ events
 
@@ -205,6 +215,10 @@ class Scheduler:
         schedule them.  Returns outcomes (the test/introspection surface).
         The serving loop (run/serve_forever) just calls this repeatedly."""
         max_batch = max_batch or self.config.batch_size
+        if self.extenders:
+            # extenders are a per-pod HTTP round trip; keep the reference's
+            # strictly serial semantics (scheduler.go:510 pops one pod)
+            max_batch = 1
         batch = self.queue.pop_batch(max_batch, timeout=timeout)
         if not batch:
             return []
@@ -238,10 +252,18 @@ class Scheduler:
 
     def _schedule_group(self, fwk: Framework,
                         qpods: List[QueuedPodInfo]) -> List[ScheduleOutcome]:
+        trace = Trace("Scheduling", profile=fwk.profile_name,
+                      pods=len(qpods))
         # ---- snapshot (reference: generic_scheduler.go:155 snapshot())
         self.cache.update_snapshot(self.snapshot)
         node_infos = self.snapshot.node_info_list
         n_nodes = len(node_infos)
+        trace.step("Snapshotting scheduler cache and node infos done")
+        if self.metrics:
+            self.metrics.cache_size.set(n_nodes, "nodes")
+            self.metrics.cache_size.set(self.cache.pod_count(), "pods")
+            self.metrics.cache_size.set(len(self.cache.assumed_pods),
+                                        "assumed_pods")
 
         # ---- host PreFilter + basic checks; build scheduleable set
         states: Dict[str, CycleState] = {}
@@ -295,7 +317,14 @@ class Scheduler:
                 host_ok[i, j] = st.is_success()
         cfg = programs.ProgramConfig(
             filters=fwk.tensor_filters, scores=fwk.tensor_scores,
-            hostname_topokey=max(builder.table.topokey.get(api.LABEL_HOSTNAME), 0))
+            hostname_topokey=max(builder.table.topokey.get(api.LABEL_HOSTNAME), 0),
+            plugin_args=fwk.tensor_plugin_args(builder.table))
+        trace.step("Tensorizing snapshot and pod batch done")
+
+        if self.extenders:
+            return outcomes + self._schedule_with_extenders(
+                fwk, live, states, node_infos, cluster, batch, cfg,
+                host_ok if any_host else None)
 
         # ---- device: one scan for the whole group
         res = schedule_sequential(
@@ -305,6 +334,7 @@ class Scheduler:
         chosen = np.asarray(res.chosen)[:len(live)]
         n_feas = np.asarray(res.n_feasible)[:len(live)]
         unres = np.asarray(res.all_unresolvable)[:len(live)]
+        trace.step("Computing predicates and priorities on device done")
 
         # ---- commit each placement in scan order
         for i, qp in enumerate(live):
@@ -319,18 +349,93 @@ class Scheduler:
             outcome = self._commit(fwk, qp, state, node_name,
                                    int(n_feas[i]))
             outcomes.append(outcome)
+        trace.step("Committing placements done")
+        trace.log_if_long()
+        return outcomes
+
+    def _schedule_with_extenders(self, fwk: Framework, live, states,
+                                 node_infos, cluster, batch, cfg,
+                                 host_ok) -> List[ScheduleOutcome]:
+        """Extender path (reference: generic_scheduler.go:497
+        findNodesThatPassExtenders + :674-706 extender Prioritize combine):
+        one batch filter+score on device, then per pod the HTTP webhooks
+        refine feasibility/scores and selection happens host-side."""
+        from .extender import MAX_EXTENDER_PRIORITY, ExtenderError
+        import random
+        res = programs.filter_and_score(
+            cluster, batch, cfg,
+            self._jax.numpy.asarray(host_ok) if host_ok is not None else None)
+        feasible = np.asarray(res.feasible)
+        scores = np.asarray(res.scores)
+        n_nodes = len(node_infos)
+        outcomes: List[ScheduleOutcome] = []
+        for i, qp in enumerate(live):
+            state = states[qp.pod.uid]
+            names = [node_infos[j].node_name for j in range(n_nodes)
+                     if feasible[i, j]]
+            dev_score = {node_infos[j].node_name: float(scores[i, j])
+                         for j in range(n_nodes) if feasible[i, j]}
+            exts = [e for e in self.extenders if e.is_interested(qp.pod)]
+            err = None
+            try:
+                for e in exts:
+                    names, _ = e.filter(qp.pod, names)
+                    # an extender may echo names outside the device-feasible
+                    # set (stale cache, typo) — never let those through
+                    names = [n for n in names if n in dev_score]
+                    if not names:
+                        break
+            except ExtenderError as ex:
+                err = f"extender filter failed: {ex}"
+            if err is not None:
+                outcomes.append(self._fail(fwk, qp, state, "", err,
+                                           preemption_may_help=False))
+                continue
+            if not names:
+                outcomes.append(self._fail(
+                    fwk, qp, state, "", f"0/{n_nodes} nodes are available"))
+                continue
+            combined = {n: 0.0 for n in names}
+            try:
+                for e in exts:
+                    for n, s in e.prioritize(qp.pod, names).items():
+                        if n in combined:
+                            combined[n] += s
+            except ExtenderError as ex:
+                outcomes.append(self._fail(fwk, qp, state, "",
+                                           f"extender prioritize failed: {ex}",
+                                           preemption_may_help=False))
+                continue
+            scale = fw.MAX_NODE_SCORE / MAX_EXTENDER_PRIORITY
+            totals = {n: dev_score[n] + combined[n] * scale for n in names}
+            best = max(totals.values())
+            ties = [n for n in names if totals[n] == best]
+            self._rng_counter += 1
+            node_name = random.Random(self._rng_counter).choice(ties)
+
+            binders = [e for e in exts if e.is_binder()]
+            binder = None
+            if binders:
+                def binder(pod, node, _b=binders[0]):
+                    _b.bind(pod, node)
+            outcomes.append(self._commit(fwk, qp, state, node_name, len(names),
+                                         binder_override=binder))
         return outcomes
 
     # ------------------------------------------------------------------ commit
 
     def _commit(self, fwk: Framework, qp: QueuedPodInfo, state: CycleState,
-                node_name: str, n_feasible: int) -> ScheduleOutcome:
+                node_name: str, n_feasible: int,
+                binder_override=None) -> ScheduleOutcome:
         pod = qp.pod
-        # Reserve (reference: scheduler.go:586)
+        # Reserve (reference: scheduler.go:586).  Commit-phase failures are
+        # not FitErrors, so they never trigger preemption
+        # (reference: scheduler.go:542 err type check).
         st = fwk.run_reserve_plugins(state, pod, node_name)
         if not st.is_success():
             fwk.run_unreserve_plugins(state, pod, node_name)
-            return self._fail(fwk, qp, state, node_name, st.message())
+            return self._fail(fwk, qp, state, node_name, st.message(),
+                              preemption_may_help=False)
 
         # assume (reference: scheduler.go:435,593)
         assumed = copy.deepcopy(pod)
@@ -339,19 +444,21 @@ class Scheduler:
             self.cache.assume_pod(assumed)
         except ValueError as e:
             fwk.run_unreserve_plugins(state, pod, node_name)
-            return self._fail(fwk, qp, state, node_name, str(e))
+            return self._fail(fwk, qp, state, node_name, str(e),
+                              preemption_may_help=False)
 
         # Permit (reference: scheduler.go:608)
         st = fwk.run_permit_plugins(state, pod, node_name)
         if not st.is_success() and st.code != Code.WAIT:
             self._forget(assumed)
             fwk.run_unreserve_plugins(state, pod, node_name)
-            return self._fail(fwk, qp, state, node_name, st.message())
+            return self._fail(fwk, qp, state, node_name, st.message(),
+                              preemption_may_help=False)
 
         # binding cycle (reference: scheduler.go:628 goroutine)
         if self._async_binding:
             fut = self._bind_pool.submit(self._bind_cycle, fwk, qp, state,
-                                         assumed, node_name)
+                                         assumed, node_name, binder_override)
             # prune completed futures so a long-running scheduler doesn't
             # retain one CycleState + pod copy per scheduled pod
             self._inflight_binds = [f for f in self._inflight_binds
@@ -359,12 +466,14 @@ class Scheduler:
             self._inflight_binds.append(fut)
             err = None
         else:
-            err = self._bind_cycle(fwk, qp, state, assumed, node_name)
+            err = self._bind_cycle(fwk, qp, state, assumed, node_name,
+                                   binder_override)
         return ScheduleOutcome(pod=pod, node=node_name if err is None else "",
                                err=err, n_feasible=n_feasible)
 
     def _bind_cycle(self, fwk: Framework, qp: QueuedPodInfo, state: CycleState,
-                    assumed: api.Pod, node_name: str) -> Optional[str]:
+                    assumed: api.Pod, node_name: str,
+                    binder_override=None) -> Optional[str]:
         """reference: scheduler.go:628-687."""
         pod = qp.pod
         st = fwk.wait_on_permit(pod)
@@ -379,7 +488,16 @@ class Scheduler:
             fwk.run_unreserve_plugins(state, pod, node_name)
             self._record_failure(fwk, qp, st.message())
             return st.message() or "prebind failed"
-        st = fwk.run_bind_plugins(state, pod, node_name)
+        bind_start = time.time()
+        if binder_override is not None:
+            # extender binding (reference: scheduler.go:457 extendersBinding)
+            try:
+                binder_override(pod, node_name)
+                st = Status.success()
+            except Exception as e:
+                st = Status.error(f"extender bind failed: {e}")
+        else:
+            st = fwk.run_bind_plugins(state, pod, node_name)
         if not st.is_success():
             self._forget(assumed)
             fwk.run_unreserve_plugins(state, pod, node_name)
@@ -387,6 +505,12 @@ class Scheduler:
             return st.message() or "bind failed"
         self.cache.finish_binding(assumed)
         fwk.run_post_bind_plugins(state, pod, node_name)
+        if self.metrics:
+            now = time.time()
+            self.metrics.binding_duration.observe(now - bind_start)
+            self.metrics.pod_scheduled(
+                qp.attempts, now - qp.initial_attempt_timestamp,
+                now - qp.timestamp)
         if self.recorder:
             self.recorder.event(pod, "Normal", "Scheduled",
                                 f"Successfully assigned "

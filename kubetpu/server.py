@@ -1,0 +1,67 @@
+"""Serving: /healthz, /metrics, /configz endpoints.
+
+reference: cmd/kube-scheduler/app/server.go:167-199 (health + metrics
+servers on the secure/insecure ports, configz registration) and
+staging/src/k8s.io/component-base/configz.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import asdict, is_dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class SchedulerServer:
+    def __init__(self, scheduler, host: str = "127.0.0.1", port: int = 10251):
+        self.scheduler = scheduler
+        self.host, self.port = host, port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        sched = self.scheduler
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, body: str,
+                      ctype: str = "text/plain; charset=utf-8"):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send(200, "ok")
+                elif self.path == "/metrics":
+                    if sched.metrics is None:
+                        self._send(200, "")
+                    else:
+                        self._send(200, sched.metrics.expose_text(),
+                                   "text/plain; version=0.0.4")
+                elif self.path == "/configz":
+                    cfg = sched.config
+                    doc = asdict(cfg) if is_dataclass(cfg) else vars(cfg)
+                    self._send(200, json.dumps(doc, default=str, indent=2),
+                               "application/json")
+                else:
+                    self._send(404, "not found")
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd = None

@@ -1,0 +1,126 @@
+"""Leader election over a lease object.
+
+reference: staging/src/k8s.io/client-go/tools/leaderelection/
+leaderelection.go:111 (LeaderElector: acquire/renew loop over a
+resourcelock) and cmd/kube-scheduler/app/server.go:203-218 (scheduler
+exits when it loses the lease).  The TPU mesh is a single logical
+scheduler; leader election provides HA of the *host process* exactly as in
+the reference (SURVEY.md §2.3 multi-process scale-out).
+
+The lock backend is pluggable; LeaseLock works against any object with
+get/update/create semantics — in-process it uses the ClusterStore so
+integration tests can run two contending schedulers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+DEFAULT_LEASE_DURATION = 15.0   # reference: leaderelection defaults
+DEFAULT_RENEW_DEADLINE = 10.0
+DEFAULT_RETRY_PERIOD = 2.0
+
+
+@dataclass
+class LeaseRecord:
+    holder: str = ""
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    lease_duration: float = DEFAULT_LEASE_DURATION
+
+
+class InMemoryLock:
+    """Shared lock object (the coordination/v1 Lease analog)."""
+
+    def __init__(self):
+        self._rec = LeaseRecord()
+        self._mu = threading.Lock()
+
+    def get(self) -> LeaseRecord:
+        with self._mu:
+            return LeaseRecord(**vars(self._rec))
+
+    def try_acquire_or_renew(self, identity: str, lease_duration: float,
+                             now: float) -> bool:
+        with self._mu:
+            rec = self._rec
+            expired = now > rec.renew_time + rec.lease_duration
+            if rec.holder and rec.holder != identity and not expired:
+                return False
+            if rec.holder != identity:
+                rec.holder = identity
+                rec.acquire_time = now
+            rec.renew_time = now
+            rec.lease_duration = lease_duration
+            return True
+
+    def release(self, identity: str) -> None:
+        with self._mu:
+            if self._rec.holder == identity:
+                self._rec = LeaseRecord()
+
+
+class LeaderElector:
+    """reference: leaderelection.go:111 LeaderElector.Run — OnStartedLeading
+    / OnStoppedLeading callbacks; stopping leadership is fatal for the
+    scheduler process (server.go:217 klog.Fatalf)."""
+
+    def __init__(self, lock: InMemoryLock,
+                 on_started_leading: Callable[[], None],
+                 on_stopped_leading: Callable[[], None],
+                 identity: Optional[str] = None,
+                 lease_duration: float = DEFAULT_LEASE_DURATION,
+                 retry_period: float = DEFAULT_RETRY_PERIOD,
+                 clock: Callable[[], float] = time.time):
+        self.lock = lock
+        self.identity = identity or f"sched-{uuid.uuid4().hex[:8]}"
+        self.on_started = on_started_leading
+        self.on_stopped = on_stopped_leading
+        self.lease_duration = lease_duration
+        self.retry_period = retry_period
+        self._clock = clock
+        self._stop = threading.Event()
+        self.is_leader = False
+        self._thread: Optional[threading.Thread] = None
+
+    def run(self, block: bool = False) -> None:
+        def loop():
+            while not self._stop.is_set():
+                ok = self.lock.try_acquire_or_renew(
+                    self.identity, self.lease_duration, self._clock())
+                if ok and not self.is_leader:
+                    self.is_leader = True
+                    self.on_started()
+                elif not ok and self.is_leader:
+                    # lost the lease — fatal for the real process
+                    self.is_leader = False
+                    self.on_stopped()
+                    return
+                self._stop.wait(self.retry_period)
+        if block:
+            loop()
+        else:
+            self._thread = threading.Thread(target=loop, daemon=True)
+            self._thread.start()
+
+    def step(self) -> bool:
+        """Single non-blocking acquire/renew attempt (for tests)."""
+        ok = self.lock.try_acquire_or_renew(
+            self.identity, self.lease_duration, self._clock())
+        if ok and not self.is_leader:
+            self.is_leader = True
+            self.on_started()
+        elif not ok and self.is_leader:
+            self.is_leader = False
+            self.on_stopped()
+        return self.is_leader
+
+    def release(self) -> None:
+        self._stop.set()
+        if self.is_leader:
+            self.lock.release(self.identity)
+            self.is_leader = False

@@ -1,0 +1,53 @@
+"""Step tracing with threshold logging.
+
+reference: vendor/k8s.io/utils/trace (utiltrace.Trace) as used by the
+scheduling cycle (core/generic_scheduler.go:147-202 — steps "Basic checks
+done", "Snapshotting scheduler cache and node infos done", "Computing
+predicates done", "Prioritizing done", logged when the cycle exceeds
+100 ms) — SURVEY.md §5 keeps the same span structure and slow-cycle log.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional, Tuple
+
+LOG = logging.getLogger("kubetpu.trace")
+
+SLOW_CYCLE_THRESHOLD = 0.1  # 100 ms (generic_scheduler.go:148 LogIfLong)
+
+
+class Trace:
+    def __init__(self, name: str, **fields):
+        self.name = name
+        self.fields = fields
+        self.start = time.time()
+        self.steps: List[Tuple[float, str]] = []
+
+    def step(self, msg: str) -> None:
+        self.steps.append((time.time(), msg))
+
+    def total(self) -> float:
+        return time.time() - self.start
+
+    def log_if_long(self, threshold: float = SLOW_CYCLE_THRESHOLD) -> Optional[str]:
+        total = self.total()
+        if total < threshold:
+            return None
+        fields = ",".join(f"{k}:{v}" for k, v in self.fields.items())
+        lines = [f'Trace "{self.name}" ({fields}) (total {total * 1000:.0f}ms):']
+        last = self.start
+        for ts, msg in self.steps:
+            lines.append(f"  ---\"{msg}\" {(ts - last) * 1000:.0f}ms")
+            last = ts
+        out = "\n".join(lines)
+        LOG.info(out)
+        return out
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.log_if_long()
+        return False

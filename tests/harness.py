@@ -29,6 +29,7 @@ def run_cluster(nodes: List[api.Node],
                 filters=programs.DEFAULT_FILTER_PLUGINS,
                 scores=programs.DEFAULT_SCORE_PLUGINS,
                 spread_selectors=None,
+                plugin_args=(),
                 seed: int = 0) -> Result:
     existing = existing or {}
     infos = []
@@ -48,7 +49,8 @@ def run_cluster(nodes: List[api.Node],
                          pb.build(pinfos, spread_selectors=spread_selectors))
     cfg = programs.ProgramConfig(
         filters=tuple(filters), scores=tuple(scores),
-        hostname_topokey=sb.table.topokey.get(api.LABEL_HOSTNAME))
+        hostname_topokey=sb.table.topokey.get(api.LABEL_HOSTNAME),
+        plugin_args=tuple(plugin_args))
     res, chosen = programs.schedule_batch(cluster, batch, cfg,
                                           jax.random.PRNGKey(seed))
     return Result(res, chosen, len(nodes), len(pending), [n.name for n in nodes])

@@ -1,0 +1,114 @@
+"""Component config decode/default/validate + legacy Policy translation
+(reference: pkg/scheduler/apis/config tests, legacy_registry_test.go)."""
+import pytest
+
+from kubetpu.apis import load as cfgload
+from kubetpu.apis.config import KubeSchedulerConfiguration
+from kubetpu.framework.runtime import Framework
+from kubetpu.plugins.intree import new_in_tree_registry
+from kubetpu.utils.features import FeatureGate, FeatureSpec
+
+
+def test_load_config_yaml():
+    doc = {
+        "apiVersion": "kubescheduler.config.k8s.io/v1beta1",
+        "kind": "KubeSchedulerConfiguration",
+        "podInitialBackoffSeconds": 2,
+        "podMaxBackoffSeconds": 20,
+        "profiles": [
+            {"schedulerName": "default-scheduler"},
+            {"schedulerName": "no-spread",
+             "plugins": {"score": {
+                 "disabled": [{"name": "PodTopologySpread"}],
+                 "enabled": [{"name": "NodeResourcesMostAllocated",
+                              "weight": 5}]}},
+             "pluginConfig": [{"name": "InterPodAffinity",
+                               "args": {"hardPodAffinityWeight": 10}}]},
+        ],
+    }
+    cfg = cfgload.load_config(doc)
+    assert cfg.pod_initial_backoff_seconds == 2
+    assert len(cfg.profiles) == 2
+    reg = new_in_tree_registry()
+    fwk = Framework(reg, cfg.profiles[1])
+    names = [p.name() for p in fwk.score_plugins]
+    assert "PodTopologySpread" not in names
+    assert "NodeResourcesMostAllocated" in names
+    assert fwk.score_weights["NodeResourcesMostAllocated"] == 5
+    assert fwk.hard_pod_affinity_weight == 10
+    assert ("NodeResourcesMostAllocated", 5) in fwk.tensor_scores
+
+
+def test_bad_api_version_rejected():
+    with pytest.raises(cfgload.ConfigError):
+        cfgload.load_config({"apiVersion": "kubescheduler.config.k8s.io/v1",
+                             "kind": "KubeSchedulerConfiguration"})
+
+
+def test_validation_errors():
+    with pytest.raises(cfgload.ConfigError, match="percentageOfNodesToScore"):
+        cfgload.load_config({"percentageOfNodesToScore": 150})
+    with pytest.raises(cfgload.ConfigError, match="duplicate"):
+        cfgload.load_config({"profiles": [{"schedulerName": "a"},
+                                          {"schedulerName": "a"}]})
+    with pytest.raises(cfgload.ConfigError, match="podMaxBackoffSeconds"):
+        cfgload.load_config({"podInitialBackoffSeconds": 5,
+                             "podMaxBackoffSeconds": 1})
+
+
+def test_defaults_applied():
+    cfg = cfgload.load_config({})
+    assert len(cfg.profiles) == 1
+    assert cfg.profiles[0].scheduler_name == "default-scheduler"
+    assert cfg.batch_size == 256
+
+
+def test_policy_translation():
+    policy = {
+        "kind": "Policy",
+        "predicates": [{"name": "PodFitsResources"},
+                       {"name": "PodFitsHostPorts"}],
+        "priorities": [{"name": "LeastRequestedPriority", "weight": 2},
+                       {"name": "BalancedResourceAllocation", "weight": 3},
+                       {"name": "InterPodAffinityPriority", "weight": 1}],
+        "hardPodAffinitySymmetricWeight": 7,
+    }
+    cfg = cfgload.load_policy(policy)
+    fwk = Framework(new_in_tree_registry(), cfg.profiles[0])
+    assert fwk.tensor_filters == ("NodeResourcesFit", "NodePorts")
+    assert dict(fwk.tensor_scores) == {"NodeResourcesLeastAllocated": 2,
+                                       "NodeResourcesBalancedAllocation": 3,
+                                       "InterPodAffinity": 1}
+    assert fwk.hard_pod_affinity_weight == 7
+    # DefaultBinder always present
+    assert [p.name() for p in fwk.bind_plugins] == ["DefaultBinder"]
+
+
+def test_policy_default_sets():
+    cfg = cfgload.load_policy({"kind": "Policy"})
+    fwk = Framework(new_in_tree_registry(), cfg.profiles[0])
+    assert "NodeResourcesFit" in fwk.tensor_filters
+    assert "InterPodAffinity" in fwk.tensor_filters
+    weights = dict(fwk.tensor_scores)
+    assert weights["NodePreferAvoidPods"] == 10000
+    assert weights["PodTopologySpread"] == 2
+
+
+def test_policy_unknown_predicate():
+    with pytest.raises(cfgload.ConfigError, match="unknown predicate"):
+        cfgload.load_policy({"predicates": [{"name": "Bogus"}]})
+
+
+def test_feature_gates():
+    fg = FeatureGate()
+    assert fg.enabled("EvenPodsSpread")
+    assert not fg.enabled("BalanceAttachedNodeVolumes")
+    fg.set("BalanceAttachedNodeVolumes", True)
+    assert fg.enabled("BalanceAttachedNodeVolumes")
+    with pytest.raises(KeyError):
+        fg.enabled("NoSuchGate")
+    with pytest.raises(ValueError):
+        fg.set("VolumeScheduling", False)   # locked to default
+    fg2 = FeatureGate()
+    fg2.set("AllAlpha", True)
+    assert fg2.enabled("NonPreemptingPriority")   # alpha gate flips on

@@ -1,0 +1,45 @@
+"""scheduler_perf harness smoke tests (scaled down)
+(reference: test/integration/scheduler_perf/scheduler_perf_test.go)."""
+import json
+
+from kubetpu.harness.perf import (DEFAULT_WORKLOADS, Workload, _stats,
+                                  load_workloads, run_workload)
+
+
+def test_run_workload_basic_small():
+    w = Workload(name="MiniBasic", num_nodes=8, num_init_pods=4,
+                 num_pods_to_schedule=16, batch_size=16, zones=2)
+    items = run_workload(w)
+    by_metric = {it.labels["Metric"]: it for it in items}
+    tp = by_metric["SchedulingThroughput"]
+    assert tp.unit == "pods/s"
+    assert "Incomplete" not in tp.labels     # everything scheduled
+    assert by_metric["binding_duration_seconds"].data["Average"] >= 0
+    # output must be valid strict JSON (no Infinity)
+    json.loads(json.dumps([it.to_doc() for it in items]))
+
+
+def test_run_workload_with_features():
+    w = Workload(name="MiniMixed", num_nodes=8, num_init_pods=4,
+                 num_pods_to_schedule=12, batch_size=16, zones=2,
+                 pod_anti_affinity=True, topology_spread=True,
+                 preferred_topology_spread=True, mixed=True,
+                 group_labels=12)
+    items = run_workload(w)
+    tp = [it for it in items
+          if it.labels["Metric"] == "SchedulingThroughput"][0]
+    assert "Incomplete" not in tp.labels
+
+
+def test_yaml_config_loads():
+    ws = load_workloads("config/performance-config.yaml")
+    names = {w.name for w in ws}
+    assert "SchedulingBasic" in names
+    assert "MixedSchedulingBasePod" in names
+    assert all(w.num_pods_to_schedule > 0 for w in ws)
+
+
+def test_stats_shape():
+    s = _stats([1.0, 2.0, 3.0, 4.0, 10.0])
+    assert set(s) == {"Average", "Perc50", "Perc90", "Perc99"}
+    assert s["Perc99"] == 10.0

@@ -1,0 +1,143 @@
+"""Preemption behavior (reference:
+test/integration/scheduler/preemption_test.go and
+core/generic_scheduler_test.go preemption tables)."""
+import time
+
+from kubetpu.api import types as api
+from kubetpu.client.store import ClusterStore
+from kubetpu.harness import hollow
+from kubetpu.preemption import Victims, pick_one_node_for_preemption
+from kubetpu.scheduler import Scheduler
+
+
+def fill_node(store, node_name, n=2, prio=0, cpu=1500, prefix=None):
+    pods = []
+    for i in range(n):
+        p = hollow.make_pod(f"{prefix or node_name}-victim-{i}",
+                            cpu_milli=cpu, priority=prio)
+        p.spec.node_name = node_name
+        store.add(p)
+        pods.append(p)
+    return pods
+
+
+def retry(sched, tries=12):
+    """Let backoff expire and rerun cycles until the queue drains."""
+    out = []
+    for _ in range(tries):
+        sched.queue.flush_backoff_completed()
+        sched.queue.flush_unschedulable_leftover()
+        res = sched.schedule_pending(timeout=0.0)
+        out.extend(res)
+        if not len(sched.queue):
+            break
+        time.sleep(0.5)
+    return out
+
+
+def test_preempts_lower_priority_victims():
+    store = ClusterStore()
+    for n in hollow.make_nodes(2, cpu_milli=3000):
+        store.add(n)
+    sched = Scheduler(store, async_binding=False)
+    # both nodes full of low-priority pods
+    fill_node(store, "node-0", n=2, prio=0)
+    fill_node(store, "node-1", n=2, prio=0)
+
+    high = hollow.make_pod("high", cpu_milli=2000, priority=100)
+    store.add(high)
+    first = sched.schedule_pending(timeout=0.0)
+    assert first[0].err is not None          # initial fit failure
+    live = store.get_pod("default", "high")
+    assert live.status.nominated_node_name   # nominated after preemption
+    nominated = live.status.nominated_node_name
+    # victims on the nominated node were deleted through the API
+    remaining = [p.metadata.name for p in store.list("Pod")
+                 if p.spec.node_name == nominated]
+    assert len(remaining) < 2
+    # retry binds the pod onto the nominated node
+    outcomes = retry(sched)
+    bound = store.get_pod("default", "high")
+    assert bound.spec.node_name == nominated
+
+
+def test_no_preemption_for_equal_priority():
+    store = ClusterStore()
+    store.add(hollow.make_node("n1", cpu_milli=1000))
+    sched = Scheduler(store, async_binding=False)
+    fill_node(store, "n1", n=1, prio=50, cpu=900)
+    pod = hollow.make_pod("peer", cpu_milli=500, priority=50)
+    store.add(pod)
+    out = sched.schedule_pending(timeout=0.0)
+    assert out[0].err is not None
+    assert store.get_pod("default", "peer").status.nominated_node_name == ""
+    # victim untouched
+    assert store.get_pod("default", "n1-victim-0") is not None
+
+
+def test_preemption_respects_pdb():
+    """Victims protected by a PDB are preempted only as a last resort
+    (reference: preemption_test.go PDB cases)."""
+    store = ClusterStore()
+    for n in hollow.make_nodes(2, cpu_milli=2000):
+        store.add(n)
+    sched = Scheduler(store, async_binding=False)
+    protected = fill_node(store, "node-0", n=1, prio=0, cpu=1800)
+    for p in protected:
+        p.metadata.labels["app"] = "guarded"
+        store.update(p)
+    fill_node(store, "node-1", n=1, prio=0, cpu=1800)
+    store.add(api.PodDisruptionBudget(
+        metadata=api.ObjectMeta(name="pdb"),
+        selector=api.LabelSelector(match_labels={"app": "guarded"}),
+        disruptions_allowed=0))
+
+    high = hollow.make_pod("high", cpu_milli=1000, priority=10)
+    store.add(high)
+    sched.schedule_pending(timeout=0.0)
+    nominated = store.get_pod("default", "high").status.nominated_node_name
+    assert nominated == "node-1"   # avoids the PDB-guarded victim
+    assert store.get_pod("default", "node-0-victim-0") is not None
+
+
+def test_unresolvable_nodes_not_candidates():
+    """Preemption cannot help on nodes failing NodeAffinity
+    (reference: nodesWherePreemptionMightHelp :1041)."""
+    store = ClusterStore()
+    n1 = hollow.make_node("n1", cpu_milli=1000, labels={"disk": "hdd"})
+    store.add(n1)
+    sched = Scheduler(store, async_binding=False)
+    fill_node(store, "n1", n=1, prio=0, cpu=900)
+    pod = hollow.make_pod("p", cpu_milli=500, priority=10)
+    pod.spec.node_selector = {"disk": "ssd"}
+    store.add(pod)
+    out = sched.schedule_pending(timeout=0.0)
+    assert out[0].err is not None
+    assert store.get_pod("default", "p").status.nominated_node_name == ""
+    assert store.get_pod("default", "n1-victim-0") is not None
+
+
+def test_pick_one_node_lexicographic():
+    def mk(prio_list, pdb=0, ts=0.0):
+        pods = []
+        for pr in prio_list:
+            p = hollow.make_pod(f"v{len(pods)}", priority=pr)
+            p.metadata.creation_timestamp = ts
+            pods.append(p)
+        return Victims(pods=pods, num_pdb_violations=pdb)
+
+    # fewest PDB violations wins
+    assert pick_one_node_for_preemption(
+        {"a": mk([100], pdb=1), "b": mk([100, 100], pdb=0)}) == "b"
+    # then lowest max priority
+    assert pick_one_node_for_preemption(
+        {"a": mk([50, 10]), "b": mk([40, 40])}) == "b"
+    # then lowest priority sum
+    assert pick_one_node_for_preemption(
+        {"a": mk([40, 30]), "b": mk([40, 20])}) == "b"
+    # then fewest victims
+    assert pick_one_node_for_preemption(
+        {"a": mk([40, 20, 0]), "b": mk([40, 20])}) == "b"
+    # then latest start time of top victim
+    assert pick_one_node_for_preemption(
+        {"a": mk([40], ts=100.0), "b": mk([40], ts=200.0)}) == "b"
