@@ -75,11 +75,14 @@ class FilterScoreResult(NamedTuple):
     plugin_scores: Dict[str, jnp.ndarray]  # per-plugin weighted [B, N]
 
 
-def run_filters(cluster, batch, cfg: ProgramConfig, host_ok=None):
+def run_filters(cluster, batch, cfg: ProgramConfig, host_ok=None,
+                skip: Tuple[str, ...] = ()):
     """Returns (feasible, unresolvable, node_affinity_ok).  host_ok [B, N]
     carries the verdicts of host-side (non-tensorized) filter plugins —
     volumes, out-of-tree — computed by the framework runner and ANDed in
-    here so device and host plugins share one feasibility mask."""
+    here so device and host plugins share one feasibility mask.  skip names
+    filters the caller evaluates itself (e.g. gang mode re-evaluates
+    NodeResourcesFit/NodePorts against in-flight batch placements)."""
     base = cluster.node_valid[None, :] & batch.valid[:, None]
     if host_ok is not None:
         base = base & host_ok
@@ -88,6 +91,8 @@ def run_filters(cluster, batch, cfg: ProgramConfig, host_ok=None):
     affinity_ok = K.node_affinity_filter(cluster, batch)
 
     for name in cfg.filters:
+        if name in skip:
+            continue
         if name == "NodeUnschedulable":
             ok = K.node_unschedulable_filter(cluster, batch)
         elif name == "NodeResourcesFit":

@@ -1,0 +1,29 @@
+"""The driver's multi-chip dryrun must be TPU-independent: it pins itself to
+the CPU backend, so it succeeds even when the default backend (possibly a
+broken TPU client) is unusable.  Round-1 regression: the dryrun touched the
+default backend via _example()/to_device() before falling back to CPU and
+died on a libtpu client mismatch."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dryrun_multichip_is_cpu_pinned():
+    # A fresh process with no JAX_PLATFORMS/XLA_FLAGS hints: the dryrun must
+    # set up its own CPU mesh without consulting the default backend.
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    # Make any accidental default-backend resolution fail loudly instead of
+    # silently using the healthy CPU: an unknown platform name errors the
+    # moment something initializes the default backend.
+    env["JAX_PLATFORMS"] = "nonexistent-tpu"
+    code = (
+        "import __graft_entry__ as g; g.dryrun_multichip(8); print('DRYRUN_OK')"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "DRYRUN_OK" in proc.stdout
