@@ -49,12 +49,20 @@ class SpreadConstraints(NamedTuple):
 
 
 class PodBatch(NamedTuple):
-    """B pending pods as a struct-of-arrays (a JAX pytree once jnp-ified)."""
+    """B pending pods as a struct-of-arrays (a JAX pytree once jnp-ified).
+
+    Pod label sets travel to the device as compact id lists (kv_ids/key_ids)
+    and are densified to [B, L]/[B, K] one-hots on device by densify() at
+    program entry — a pod has O(10) labels, so shipping [B, L] dense floats
+    would waste transfer bandwidth by ~L/10x.  kv_hot/key_hot are None until
+    densify() fills them."""
     req: np.ndarray            # [B, R] resource request channels
     nonzero_req: np.ndarray    # [B, 2] (cpu milli, mem MiB) with defaults
     limits: np.ndarray         # [B, R] resource limit channels
-    kv_hot: np.ndarray         # [B, L] f32 — the pod's own labels
-    key_hot: np.ndarray        # [B, K] f32
+    kv_ids: np.ndarray         # [B, ML] i32 label (key,value) vocab ids, -1 pad
+    key_ids: np.ndarray        # [B, ML] i32 label key vocab ids, -1 pad
+    kv_hot: Optional[np.ndarray]   # [B, L] bool — filled on device
+    key_hot: Optional[np.ndarray]  # [B, K] bool — filled on device
     ns_hot: np.ndarray         # [B, NS] f32 one-hot namespace
     node_name_kvid: np.ndarray  # [B] i32 kv id of (__field__metadata.name, spec.nodeName); -1 unset
     has_node_name: np.ndarray  # [B] bool
@@ -92,6 +100,27 @@ class PodBatch(NamedTuple):
         return self.req.shape[0]
 
 
+def densify_for(cluster, batch: "PodBatch") -> "PodBatch":
+    """Materialize the [B, L]/[B, K] pod-label one-hots from the id lists,
+    sized to the cluster tensors' vocab capacities.  Called once at
+    jitted-program entry (idempotent).  Ids at or beyond the cluster
+    capacity (interned after the snapshot arrays were sized) are dropped —
+    such labels exist nowhere in the cluster, so they can never match."""
+    import jax.numpy as jnp
+    if batch.kv_hot is not None:
+        return batch
+    L, K = cluster.kv.shape[1], cluster.keymask.shape[1]
+    B = batch.kv_ids.shape[0]
+    rows = jnp.arange(B)[:, None]
+    kv_hot = jnp.zeros((B, L), bool).at[
+        rows, jnp.clip(batch.kv_ids, 0, L - 1)].max(
+        (batch.kv_ids >= 0) & (batch.kv_ids < L))
+    key_hot = jnp.zeros((B, K), bool).at[
+        rows, jnp.clip(batch.key_ids, 0, K - 1)].max(
+        (batch.key_ids >= 0) & (batch.key_ids < K))
+    return batch._replace(kv_hot=kv_hot, key_hot=key_hot)
+
+
 class PodBatchBuilder:
     def __init__(self, table: InternTable):
         self.table = table
@@ -113,8 +142,10 @@ class PodBatchBuilder:
         req = np.zeros((B, R), np.float32)
         nonzero = np.zeros((B, 2), np.float32)
         limits = np.zeros((B, R), np.float32)
-        kv_hot = np.zeros((B, L), np.float32)
-        key_hot = np.zeros((B, K), np.float32)
+        ML = pow2_bucket(max((len(pi.pod.metadata.labels) for pi in pods),
+                             default=0), 4)
+        kv_ids = np.full((B, ML), -1, np.int32)
+        key_ids = np.full((B, ML), -1, np.int32)
         ns_hot = np.zeros((B, NS), np.float32)
         node_name_kvid = np.full((B,), -1, np.int32)
         has_node_name = np.zeros((B,), bool)
@@ -141,13 +172,9 @@ class PodBatchBuilder:
             nonzero[i, 1] = pi.non_zero_mem / MIB
             limits[i] = resource_to_channels(compute_pod_resource_limits(p), t, R,
                                              intern_new=False)
-            for k, v in p.metadata.labels.items():
-                j = t.kv.get((k, v))
-                if j >= 0:
-                    kv_hot[i, j] = 1.0
-                jk = t.key.get(k)
-                if jk >= 0:
-                    key_hot[i, jk] = 1.0
+            for li, (k, v) in enumerate(p.metadata.labels.items()):
+                kv_ids[i, li] = t.kv.get((k, v))
+                key_ids[i, li] = t.key.get(k)
             jn = t.ns.get(p.namespace)
             if jn >= 0:
                 ns_hot[i, jn] = 1.0
@@ -262,8 +289,10 @@ class PodBatchBuilder:
         spread_hard = self._build_spread(pods, B, hard=True)
         spread_soft = self._build_spread(pods, B, hard=False)
 
-        return PodBatch(req=req, nonzero_req=nonzero, limits=limits, kv_hot=kv_hot,
-                        key_hot=key_hot, ns_hot=ns_hot, node_name_kvid=node_name_kvid,
+        return PodBatch(req=req, nonzero_req=nonzero, limits=limits,
+                        kv_ids=kv_ids, key_ids=key_ids,
+                        kv_hot=None, key_hot=None,
+                        ns_hot=ns_hot, node_name_kvid=node_name_kvid,
                         has_node_name=has_node_name, ports_hot=ports_hot,
                         ports_asnode_hot=ports_asnode_hot,
                         tolerated=tolerated, priority=priority, images_hot=images_hot,

@@ -1,0 +1,223 @@
+"""Conflict-free batched (gang) assignment: propose-and-admit auction.
+
+The reference schedules one pod per cycle, so intra-batch capacity conflicts
+cannot happen (reference: pkg/scheduler/scheduler.go:509 scheduleOne).  The
+naive batched program (programs.schedule_batch) scores every pod against the
+same snapshot, so two pods can both claim the last slot of a node.  The
+sequential scan (models/sequential.py) is exact but pays O(B) serial steps.
+
+This module is the third mode: a parallel auction in the family of Bertsekas'
+assignment auctions, specialised to the scheduler's one-sided capacity
+constraints.  Each round, entirely on device:
+
+1. every unassigned pod *proposes* to its argmax feasible node, using the
+   same per-pod tie-break RNG as the sequential replay
+   (jax.random.fold_in(rng, pod_index) — selectHost semantics,
+   generic_scheduler.go:217);
+2. pods proposing the same node are *admitted* in pod order (the batch is
+   popped from the queue in priority order, so pod index = the reference's
+   serial order) up to the node's remaining multi-resource capacity and
+   hostPort set.  Admission is a sort by proposed node + a segmented
+   prefix-sum over request channels — no [B, N, R] intermediate, so it
+   scales to 100k x 10k;
+3. admitted placements commit: node requested/ports update, and the next
+   round recomputes feasibility *and scores* against the updated usage
+   (pods placed in later rounds see earlier rounds' placements, the batched
+   analog of the serial loop's assume; capacity semantics exactly match
+   noderesources/fit.go:194-267 + NodePorts).
+
+Invariants:
+- zero capacity violations: an admitted pod's request fits within
+  free-capacity-minus-earlier-proposers (a superset of earlier admitted),
+  and a pod whose probed hostPorts collide with any earlier proposer's
+  registered ports is deferred to the next round;
+- progress: the first proposer of every proposed-to node always fits (the
+  node was feasible for it this round), so each round either admits >=1 pod
+  or proves the remaining pods unschedulable — the loop terminates;
+- uncontended agreement: when every pod's argmax is distinct and capacity
+  suffices, round 1 admits every pod at exactly the node the sequential
+  replay picks under the same rng.
+
+Scope note: topology filters/scores (PodTopologySpread, InterPodAffinity)
+are evaluated against the snapshot plus the batch's committed *resource*
+usage, not against intra-batch topology-pair counts — gang mode trades the
+scan's serial topology carries for O(rounds) parallel passes.  Workloads
+where intra-batch topology interaction must be exact use the sequential
+replay mode.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import kernels as K
+from .programs import ProgramConfig, run_filters, run_scores
+
+_f = K._f
+_NEG = jnp.float32(-2**62)
+
+
+class GangResult(NamedTuple):
+    chosen: jnp.ndarray     # [B] i32 node row, -1 unschedulable this pass
+    score: jnp.ndarray      # [B] f32 score of the winning node at admission
+    rounds: jnp.ndarray     # i32 number of propose/admit rounds executed
+    requested: jnp.ndarray  # [N, R] final requested incl. batch placements
+    feasible0: jnp.ndarray  # [B, N] bool first-round feasibility (diagnostics)
+    unresolvable: jnp.ndarray  # [B, N] bool from the static filter pass
+
+
+def _segment_base(values: jnp.ndarray, is_start: jnp.ndarray) -> jnp.ndarray:
+    """For row-sorted segments: propagate each segment-start row's value
+    forward.  values must be non-decreasing along axis 0 (cumsum outputs),
+    so a cummax over (start ? value : -1) yields, at every row, the value at
+    its segment's first row."""
+    marked = jnp.where(is_start[:, None] if values.ndim == 2 else is_start,
+                       values, -1.0)
+    return jax.lax.cummax(marked, axis=0)
+
+
+def _fit_rows(req: jnp.ndarray, avail: jnp.ndarray) -> jnp.ndarray:
+    """Per-row NodeResourcesFit verdict for request rows [B, R] against
+    available rows [B, R] (fit.go:194-267 semantics: pod count always
+    checked; cpu/mem/ephemeral checked when the pod requests anything;
+    scalar channels only when requested)."""
+    free_ok = avail >= req
+    R = req.shape[1]
+    ch = jnp.arange(R)
+    is_fixed = (ch < K.N_FIXED_CHANNELS) & (ch != K.CH_PODS)
+    check = jnp.where(is_fixed[None, :], True, req > 0)
+    res_ok = jnp.all(free_ok | ~check | (ch == K.CH_PODS)[None, :], axis=-1)
+    pods_ok = free_ok[:, K.CH_PODS]
+    nonpods = jnp.where((ch == K.CH_PODS)[None, :], 0.0, req)
+    zero_req = jnp.all(nonpods == 0, axis=-1)
+    return pods_ok & (zero_req | res_ok)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "max_rounds"))
+def schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
+                  host_ok: Optional[jnp.ndarray] = None,
+                  max_rounds: Optional[int] = None) -> GangResult:
+    from .batch import densify_for
+    batch = densify_for(cluster, batch)
+    B = batch.req.shape[0]
+    N = cluster.allocatable.shape[0]
+    if max_rounds is None:
+        max_rounds = B
+    filters = set(cfg.filters)
+    use_fit = "NodeResourcesFit" in filters
+    use_ports = "NodePorts" in filters
+
+    # Static filters once (everything but the capacity filters the rounds
+    # re-evaluate); unresolvable mask matches run_filters' full pass because
+    # neither Fit nor Ports is an UnschedulableAndUnresolvable filter.
+    static_ok, unresolvable, affinity_ok = run_filters(
+        cluster, batch, cfg, host_ok,
+        skip=("NodeResourcesFit", "NodePorts"))
+    ports_ok0 = (K.node_ports_filter(cluster, batch) if use_ports
+                 else jnp.ones((B, N), bool))
+
+    pod_idx = jnp.arange(B, dtype=jnp.int32)
+    tie_keys = jax.vmap(lambda i: jax.random.fold_in(rng, i))(pod_idx)
+
+    P = batch.ports_hot.shape[1]
+    carry0 = dict(
+        req=cluster.requested,
+        nz=cluster.nonzero_requested,
+        ports_used=jnp.zeros((N, P), jnp.float32),
+        assigned=jnp.full((B,), -1, jnp.int32),
+        win_score=jnp.zeros((B,), jnp.float32),
+        feas0=jnp.zeros((B, N), bool),
+        rounds=jnp.int32(0),
+        progress=jnp.bool_(True),
+    )
+
+    def feasibility(c):
+        feas = static_ok
+        if use_fit:
+            cl = cluster._replace(requested=c["req"])
+            feas = feas & K.fit_filter(cl, batch)
+        if use_ports:
+            batch_conf = jnp.einsum(
+                "bp,np->bn", batch.ports_hot, c["ports_used"],
+                preferred_element_type=jnp.float32) > 0.5
+            feas = feas & ports_ok0 & ~batch_conf
+        return feas
+
+    def cond(c):
+        return c["progress"] & (c["rounds"] < max_rounds)
+
+    def body(c):
+        unassigned = (c["assigned"] < 0) & batch.valid
+        feas = feasibility(c) & unassigned[:, None]
+
+        # scores against committed usage so later rounds see earlier rounds'
+        # placements (the batched analog of assume-before-next-pod)
+        cl = cluster._replace(requested=c["req"], nonzero_requested=c["nz"])
+        scores, _ = run_scores(cl, batch, cfg, feas, affinity_ok)
+
+        masked = jnp.where(feas, scores, _NEG)
+        best = jnp.max(masked, axis=1)
+        ties = (masked == best[:, None]) & feas
+        logits = jnp.where(ties, 0.0, _NEG)
+        choice = jax.vmap(jax.random.categorical)(tie_keys, logits)
+        active = jnp.any(feas, axis=1)
+        prop = jnp.where(active, choice.astype(jnp.int32), N)  # N = no-op seg
+
+        # ---- admission: sort by proposed node (stable keeps pod order) ----
+        order = jnp.argsort(prop, stable=True)
+        snode = prop[order]
+        sactive = active[order]
+        is_start = jnp.concatenate(
+            [jnp.ones((1,), bool), snode[1:] != snode[:-1]])
+
+        sreq = batch.req[order] * _f(sactive)[:, None]          # [B, R]
+        csum = jnp.cumsum(sreq, axis=0)
+        excl = csum - sreq
+        prefix_excl = excl - _segment_base(excl, is_start)      # earlier
+        node_safe = jnp.clip(snode, 0, N - 1)                   # proposers'
+        free = (cluster.allocatable[node_safe]                  # usage
+                - c["req"][node_safe])
+        cap_ok = _fit_rows(batch.req[order], free - prefix_excl)
+
+        if use_ports:
+            sreg = batch.ports_asnode_hot[order] * _f(sactive)[:, None]
+            pcs = jnp.cumsum(sreg, axis=0)
+            pexcl = pcs - sreg
+            earlier_ports = pexcl - _segment_base(pexcl, is_start)
+            conflict = jnp.sum(batch.ports_hot[order] * earlier_ports,
+                               axis=1) > 0.5
+            cap_ok = cap_ok & ~conflict
+
+        admit_sorted = cap_ok & sactive & (snode < N)
+        admit = jnp.zeros((B,), bool).at[order].set(admit_sorted)
+
+        # ---- commit ----
+        seg = jnp.where(admit, prop, N)
+        add_req = jax.ops.segment_sum(
+            batch.req * _f(admit)[:, None], seg, num_segments=N + 1)[:N]
+        add_nz = jax.ops.segment_sum(
+            batch.nonzero_req * _f(admit)[:, None], seg,
+            num_segments=N + 1)[:N]
+        new = dict(c)
+        new["req"] = c["req"] + add_req
+        new["nz"] = c["nz"] + add_nz
+        if use_ports:
+            add_ports = jax.ops.segment_max(
+                batch.ports_asnode_hot * _f(admit)[:, None], seg,
+                num_segments=N + 1)[:N]
+            new["ports_used"] = jnp.maximum(c["ports_used"], add_ports)
+        new["assigned"] = jnp.where(admit, prop, c["assigned"])
+        new["win_score"] = jnp.where(admit, best, c["win_score"])
+        new["feas0"] = jnp.where(c["rounds"] == 0, feas, c["feas0"])
+        new["rounds"] = c["rounds"] + 1
+        new["progress"] = jnp.any(admit)
+        return new
+
+    out = jax.lax.while_loop(cond, body, carry0)
+    return GangResult(chosen=out["assigned"], score=out["win_score"],
+                      rounds=out["rounds"], requested=out["req"],
+                      feasible0=out["feas0"], unresolvable=unresolvable)

@@ -173,6 +173,8 @@ def run_scores(cluster, batch, cfg: ProgramConfig, feasible, affinity_ok):
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def filter_and_score(cluster, batch, cfg: ProgramConfig,
                      host_ok=None) -> FilterScoreResult:
+    from .batch import densify_for
+    batch = densify_for(cluster, batch)
     feasible, unresolvable, affinity_ok = run_filters(cluster, batch, cfg,
                                                       host_ok)
     scores, per_plugin = run_scores(cluster, batch, cfg, feasible, affinity_ok)

@@ -71,6 +71,8 @@ def _batch_term_matches(terms, batch, B):
 def schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
                         hard_pod_affinity_weight: float = 1.0,
                         host_ok=None) -> SeqResult:
+    from .batch import densify_for
+    batch = densify_for(cluster, batch)
     B = batch.req.shape[0]
     N = cluster.allocatable.shape[0]
     L = cluster.kv.shape[1]

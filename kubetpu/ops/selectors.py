@@ -31,18 +31,25 @@ from ..utils.intern import InternTable, pow2_bucket
 
 
 class SelectorSet(NamedTuple):
-    """S compiled selectors, each an AND of up to Q requirements.
+    """S selector *slots* backed by U <= S unique compiled selectors.
 
-    vals_hot : [S, Q, L] f32 multi-hot over (key,value) vocab (In/NotIn)
-    key_hot  : [S, Q, K] f32 multi-hot over key vocab (Exists/DoesNotExist)
-    negate   : [S, Q] bool    requirement result is inverted
-    use_key  : [S, Q] bool    requirement tests key presence, not values
-    req_valid: [S, Q] bool    padding mask for requirements
-    num_key  : [S, Q] i32     key index for Gt/Lt (0 if unused)
-    num_op   : [S, Q] i32     0 = none, 1 = Gt, 2 = Lt
-    num_val  : [S, Q] f32     comparison constant for Gt/Lt
-    sel_valid: [S] bool       padding mask for selectors (invalid => caller
-                              decides; match_selectors returns False rows)
+    Pods stamped out by one controller share identical selectors, so the
+    compiler dedups: the dense requirement tensors are stored once per
+    unique selector and each slot carries only an index.  This is the
+    difference between O(B x L) and O(U x L) memory/FLOPs for a B-pod batch
+    (hollow 100k-pod batches have U in the tens), and it is invisible to
+    callers — match_selectors still returns [S, M].
+
+    vals_hot : [U, Q, L] bool multi-hot over (key,value) vocab (In/NotIn)
+    key_hot  : [U, Q, K] bool multi-hot over key vocab (Exists/DoesNotExist)
+    negate   : [U, Q] bool    requirement result is inverted
+    use_key  : [U, Q] bool    requirement tests key presence, not values
+    req_valid: [U, Q] bool    padding mask for requirements
+    num_key  : [U, Q] i32     key index for Gt/Lt (0 if unused)
+    num_op   : [U, Q] i32     0 = none, 1 = Gt, 2 = Lt
+    num_val  : [U, Q] f32     comparison constant for Gt/Lt
+    sel_valid: [U] bool       nil/padding selectors (match nothing)
+    index    : [S] i32        slot -> unique row
     """
     vals_hot: jnp.ndarray
     key_hot: jnp.ndarray
@@ -53,10 +60,11 @@ class SelectorSet(NamedTuple):
     num_op: jnp.ndarray
     num_val: jnp.ndarray
     sel_valid: jnp.ndarray
+    index: jnp.ndarray
 
     @property
     def n_selectors(self) -> int:
-        return self.vals_hot.shape[0]
+        return self.index.shape[0]
 
 
 def match_selectors(sel: SelectorSet,
@@ -64,15 +72,16 @@ def match_selectors(sel: SelectorSet,
                     key: jnp.ndarray,     # [M, K] bool/float — target has key
                     num: Optional[jnp.ndarray] = None,  # [M, K] f32 numeric label values (NaN = non-numeric)
                     ) -> jnp.ndarray:
-    """Match S selectors against M targets -> [S, M] bool.
+    """Match S selector slots against M targets -> [S, M] bool.
 
-    The two einsums are batched matmuls; everything else fuses into them.
+    The two einsums are batched matmuls over the U unique selectors;
+    per-slot results are a gather on the slot index.
     """
     kv_f = kv.astype(jnp.float32)
     key_f = key.astype(jnp.float32)
-    cnt_v = jnp.einsum("sql,ml->sqm", sel.vals_hot, kv_f,
+    cnt_v = jnp.einsum("uql,ml->uqm", sel.vals_hot.astype(jnp.float32), kv_f,
                        preferred_element_type=jnp.float32)
-    cnt_k = jnp.einsum("sqk,mk->sqm", sel.key_hot, key_f,
+    cnt_k = jnp.einsum("uqk,mk->uqm", sel.key_hot.astype(jnp.float32), key_f,
                        preferred_element_type=jnp.float32)
     present = jnp.where(sel.use_key[..., None], cnt_k > 0.5, cnt_v > 0.5)
     ok = present ^ sel.negate[..., None]
@@ -80,7 +89,7 @@ def match_selectors(sel: SelectorSet,
     if num is not None:
         # Gt/Lt: gather each requirement's numeric label column.
         nval = jnp.take(num.T, jnp.clip(sel.num_key, 0, num.shape[1] - 1),
-                        axis=0)  # [S, Q, M]
+                        axis=0)  # [U, Q, M]
         is_gt = sel.num_op[..., None] == 1
         cmp = jnp.where(is_gt, nval > sel.num_val[..., None],
                         nval < sel.num_val[..., None])
@@ -88,7 +97,8 @@ def match_selectors(sel: SelectorSet,
         ok = jnp.where(sel.num_op[..., None] > 0, cmp, ok)
 
     ok = jnp.logical_or(ok, jnp.logical_not(sel.req_valid[..., None]))
-    return jnp.logical_and(jnp.all(ok, axis=1), sel.sel_valid[:, None])
+    uniq = jnp.logical_and(jnp.all(ok, axis=1), sel.sel_valid[:, None])
+    return jnp.take(uniq, sel.index, axis=0)
 
 
 # ---------------------------------------------------------------------------
@@ -142,24 +152,44 @@ class SelectorCompiler:
         """intern_new: selectors may introduce vocab entries (normally the
         snapshot builder has already interned all cluster labels; pod
         selectors referencing unknown values simply never match, so lookups
-        use get() when intern_new=False)."""
-        req_lists = [_reqs_of(s) for s in selectors]
-        max_q = max((len(r) for r in req_lists if r), default=1)
-        Q = pow2_bucket(max_q, 2)
+        use get() when intern_new=False).
+
+        Identical requirement lists compile to ONE unique row shared via the
+        slot index — both the numpy build work and the device tensors scale
+        with the number of distinct selectors, not the batch size."""
+        all_req_lists = [_reqs_of(s) for s in selectors]
         S = pad_s if pad_s is not None else pow2_bucket(len(selectors), 1)
         if S < len(selectors):
             raise ValueError("pad_s smaller than selector count")
+
+        uniq: dict = {}
+        index = np.zeros((S,), np.int32)
+        req_lists: List[Optional[List[_Req]]] = []
+        for i in range(S):
+            reqs = all_req_lists[i] if i < len(all_req_lists) else None
+            k = None if reqs is None else tuple(
+                (r.op, r.key, tuple(r.values)) for r in reqs)
+            u = uniq.get(k)
+            if u is None:
+                u = len(req_lists)
+                uniq[k] = u
+                req_lists.append(reqs)
+            index[i] = u
+
+        max_q = max((len(r) for r in req_lists if r), default=1)
+        Q = pow2_bucket(max_q, 2)
+        U = pow2_bucket(len(req_lists), 1)
         L, K = self.table.kv.cap, self.table.key.cap
 
-        vals_hot = np.zeros((S, Q, L), np.float32)
-        key_hot = np.zeros((S, Q, K), np.float32)
-        negate = np.zeros((S, Q), bool)
-        use_key = np.zeros((S, Q), bool)
-        req_valid = np.zeros((S, Q), bool)
-        num_key = np.zeros((S, Q), np.int32)
-        num_op = np.zeros((S, Q), np.int32)
-        num_val = np.zeros((S, Q), np.float32)
-        sel_valid = np.zeros((S,), bool)
+        vals_hot = np.zeros((U, Q, L), bool)
+        key_hot = np.zeros((U, Q, K), bool)
+        negate = np.zeros((U, Q), bool)
+        use_key = np.zeros((U, Q), bool)
+        req_valid = np.zeros((U, Q), bool)
+        num_key = np.zeros((U, Q), np.int32)
+        num_op = np.zeros((U, Q), np.int32)
+        num_val = np.zeros((U, Q), np.float32)
+        sel_valid = np.zeros((U,), bool)
 
         kv_id = (self.table.kv.intern if intern_new else self.table.kv.get)
         key_id = (self.table.key.intern if intern_new else self.table.key.get)
@@ -200,4 +230,5 @@ class SelectorCompiler:
 
         return SelectorSet(vals_hot=vals_hot, key_hot=key_hot, negate=negate,
                            use_key=use_key, req_valid=req_valid, num_key=num_key,
-                           num_op=num_op, num_val=num_val, sel_valid=sel_valid)
+                           num_op=num_op, num_val=num_val, sel_valid=sel_valid,
+                           index=index)

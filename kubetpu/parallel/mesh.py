@@ -33,7 +33,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
-from ..models import programs, sequential
+from ..models import gang, programs, sequential
 from ..state.tensors import ClusterTensors
 
 AXIS_PODS = "pods"
@@ -112,6 +112,21 @@ def sharded_schedule_batch(cluster, batch, cfg: programs.ProgramConfig, rng,
     rng = jax.device_put(rng, NamedSharding(mesh, P()))
     with jax.set_mesh(mesh):
         return programs.schedule_batch(cluster, batch, cfg, rng)
+
+
+def sharded_schedule_gang(cluster, batch, cfg: programs.ProgramConfig, rng,
+                          mesh: Mesh, shard_existing_pods: bool = True,
+                          max_rounds: Optional[int] = None):
+    """Gang auction over the mesh.  The [B, N] filter/score work shards over
+    both axes; the admission sort + segmented prefix-sums are [B]-sized (a
+    few MB even at 100k pods), which XLA gathers as needed — the per-round
+    collectives replace the serial loop's cross-pod carries."""
+    cluster = shard_cluster(cluster, mesh, shard_existing_pods)
+    batch = shard_batch(batch, mesh)
+    rng = jax.device_put(rng, NamedSharding(mesh, P()))
+    with jax.set_mesh(mesh):
+        return gang.schedule_gang(cluster, batch, cfg, rng,
+                                  max_rounds=max_rounds)
 
 
 def sharded_schedule_sequential(cluster, batch, cfg: programs.ProgramConfig,

@@ -1,0 +1,36 @@
+"""Persistent XLA compilation cache.
+
+The scheduling programs are large (the sequential scan and the gang auction
+compile in tens of seconds at serving shapes) but their shapes are bucketed
+(utils/intern.py pow2_bucket), so a process restart recompiles byte-identical
+programs.  Enabling JAX's persistent compilation cache makes warm restarts
+skip XLA entirely — the serving analog of the reference reusing a running
+process (there is no compile step to amortize in Go; here there is, and this
+bounds it).
+"""
+
+from __future__ import annotations
+
+import os
+
+DEFAULT_CACHE_DIR = os.path.expanduser("~/.cache/kubetpu/xla")
+
+_enabled: str | None = None  # cache dir once enabled
+
+
+def enable_persistent_cache(cache_dir: str | None = None) -> str:
+    """Idempotently enable the JAX persistent compilation cache.  Returns
+    the cache directory in use.  Safe to call before or after jax init."""
+    global _enabled
+    if _enabled:
+        return _enabled
+    cache_dir = cache_dir or os.environ.get("KUBETPU_XLA_CACHE_DIR",
+                                            DEFAULT_CACHE_DIR)
+    os.makedirs(cache_dir, exist_ok=True)
+    import jax
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # cache every program: even sub-second kernels add up across restarts
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    _enabled = cache_dir
+    return cache_dir

@@ -11,6 +11,7 @@ import pytest
 
 import __graft_entry__ as graft
 from kubetpu.models import programs
+from kubetpu.models.gang import schedule_gang
 from kubetpu.models.sequential import schedule_sequential
 from kubetpu.parallel import mesh as pmesh
 
@@ -40,6 +41,18 @@ def test_sharded_batch_matches_single_device():
     np.testing.assert_allclose(np.asarray(ref_res.scores),
                                np.asarray(res.scores), rtol=0, atol=0)
     np.testing.assert_array_equal(np.asarray(ref_chosen), np.asarray(chosen))
+
+
+def test_sharded_gang_matches_single_device():
+    cluster, batch, cfg, rng = _inputs()
+    ref = schedule_gang(cluster, batch, cfg, rng)
+
+    mesh = pmesh.make_mesh((2, 4), devices=cpu_devices[:8])
+    res = pmesh.sharded_schedule_gang(cluster, batch, cfg, rng, mesh)
+
+    np.testing.assert_array_equal(np.asarray(ref.chosen), np.asarray(res.chosen))
+    np.testing.assert_allclose(np.asarray(ref.requested),
+                               np.asarray(res.requested), rtol=0, atol=0)
 
 
 def test_sharded_sequential_matches_single_device():
