@@ -94,6 +94,12 @@ class KubeSchedulerConfiguration:
     extenders: List[Any] = field(default_factory=list)
     # TPU extensions
     batch_size: int = 256        # device batch (B axis); 1 = exact replay
+    # "sequential": the lax.scan replay preserving the reference's serial
+    # scheduleOne semantics exactly (scheduler.go:509).  "gang": the
+    # conflict-free auction (models/gang.py) — O(rounds) parallel passes,
+    # exact capacity/hostPort semantics, topology scored against the
+    # snapshot rather than intra-batch placements.
+    mode: str = "sequential"
     mesh_shape: Optional[tuple] = None
 
     def profile_for(self, name: str) -> Optional[KubeSchedulerProfile]:

@@ -60,6 +60,7 @@ def load_config(doc: Dict[str, Any]) -> KubeSchedulerConfiguration:
     cfg.health_bind_address = doc.get("healthzBindAddress", "")
     cfg.extenders = list(doc.get("extenders", []) or [])
     cfg.batch_size = doc.get("batchSize", 256)  # TPU extension
+    cfg.mode = doc.get("mode", "sequential")    # TPU extension
     cfg.profiles = [_decode_profile(p) for p in doc.get("profiles", [])]
     apply_defaults(cfg)
     validate(cfg)
@@ -106,6 +107,8 @@ def validate(cfg: KubeSchedulerConfiguration) -> None:
         errs.append("percentageOfNodesToScore must be in [0, 100]")
     if cfg.pod_initial_backoff_seconds <= 0:
         errs.append("podInitialBackoffSeconds must be > 0")
+    if cfg.mode not in ("sequential", "gang"):
+        errs.append("mode must be 'sequential' or 'gang'")
     if cfg.pod_max_backoff_seconds < cfg.pod_initial_backoff_seconds:
         errs.append("podMaxBackoffSeconds must be >= podInitialBackoffSeconds")
     names = [p.scheduler_name for p in cfg.profiles]

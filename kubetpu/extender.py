@@ -135,3 +135,31 @@ class HTTPExtender:
 
     def supports_preemption(self) -> bool:
         return bool(self.preempt_verb)
+
+    def process_preemption(self, pod: api.Pod, node_victims: Dict):
+        """reference: core/extender.go:317 ProcessPreemption — the extender
+        may trim victims per node or drop nodes entirely; nodes absent from
+        its result are no longer preemption candidates.  node_victims maps
+        node name -> Victims (kubetpu.preemption)."""
+        from .preemption import Victims
+        args = {
+            "pod": _pod_doc(pod),
+            "nodeNameToMetaVictims": {
+                name: {
+                    "pods": [{"uid": p.uid} for p in v.pods],
+                    "numPDBViolations": v.num_pdb_violations,
+                } for name, v in node_victims.items()},
+        }
+        result = self._send(self.preempt_verb, args)
+        by_uid = {p.uid: p
+                  for v in node_victims.values() for p in v.pods}
+        out = {}
+        for name, meta in (result.get("nodeNameToMetaVictims") or {}).items():
+            if name not in node_victims:
+                continue  # never accept nodes we did not offer
+            pods = [by_uid[m["uid"]] for m in (meta.get("pods") or [])
+                    if m.get("uid") in by_uid]
+            out[name] = Victims(
+                pods=pods,
+                num_pdb_violations=meta.get("numPDBViolations", 0))
+        return out

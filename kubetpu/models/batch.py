@@ -100,6 +100,38 @@ class PodBatch(NamedTuple):
         return self.req.shape[0]
 
 
+class NominatedPods(NamedTuple):
+    """Pods nominated to nodes by preemption, overlaid onto node usage when
+    filtering lower/equal-priority pods (reference: addNominatedPods,
+    core/generic_scheduler.go:530 — equal-or-greater priority nominated pods
+    are treated as running on their nominated node).  The tensor overlay
+    covers the resource/pod-count dimension of AddPod; topology-term
+    contributions of nominated pods are not overlaid."""
+    req: np.ndarray    # [M, R] request channels (CH_PODS = 1)
+    node: np.ndarray   # [M] i32 node row
+    prio: np.ndarray   # [M] i32 pod priority
+    valid: np.ndarray  # [M] bool
+
+
+def build_nominated(entries: Sequence, table: InternTable,
+                    pad_m: Optional[int] = None) -> NominatedPods:
+    """entries: (PodInfo, node_row) pairs for pods nominated to snapshot
+    rows.  Returns the device overlay arrays (pow2-padded)."""
+    R = N_FIXED_CHANNELS + table.rname.cap
+    M = pad_m if pad_m is not None else pow2_bucket(len(entries), 1)
+    req = np.zeros((M, R), np.float32)
+    node = np.full((M,), -1, np.int32)
+    prio = np.zeros((M,), np.int32)
+    valid = np.zeros((M,), bool)
+    for i, (pi, row) in enumerate(entries):
+        req[i] = resource_to_channels(pi.resource, table, R, intern_new=False)
+        req[i, CH_PODS] = 1.0
+        node[i] = row
+        prio[i] = pi.pod.priority()
+        valid[i] = True
+    return NominatedPods(req=req, node=node, prio=prio, valid=valid)
+
+
 def densify_for(cluster, batch: "PodBatch") -> "PodBatch":
     """Materialize the [B, L]/[B, K] pod-label one-hots from the id lists,
     sized to the cluster tensors' vocab capacities.  Called once at

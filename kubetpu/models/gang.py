@@ -68,6 +68,10 @@ class GangResult(NamedTuple):
     requested: jnp.ndarray  # [N, R] final requested incl. batch placements
     feasible0: jnp.ndarray  # [B, N] bool first-round feasibility (diagnostics)
     unresolvable: jnp.ndarray  # [B, N] bool from the static filter pass
+    n_feasible: jnp.ndarray    # [B] i32 first-round feasible-node count
+    all_unresolvable: jnp.ndarray  # [B] bool — every failed node failed
+                            # UnschedulableAndUnresolvable (preemption gate,
+                            # scheduler.go:391; matches SeqResult's field)
 
 
 def _segment_base(values: jnp.ndarray, is_start: jnp.ndarray) -> jnp.ndarray:
@@ -218,6 +222,13 @@ def schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
         return new
 
     out = jax.lax.while_loop(cond, body, carry0)
+    base = cluster.node_valid[None, :] & batch.valid[:, None]
+    if host_ok is not None:
+        base = base & host_ok
+    all_unres = jnp.all(unresolvable | out["feas0"] | ~base, axis=1)
     return GangResult(chosen=out["assigned"], score=out["win_score"],
                       rounds=out["rounds"], requested=out["req"],
-                      feasible0=out["feas0"], unresolvable=unresolvable)
+                      feasible0=out["feas0"], unresolvable=unresolvable,
+                      n_feasible=jnp.sum(out["feas0"].astype(jnp.int32),
+                                         axis=1),
+                      all_unresolvable=all_unres)

@@ -75,6 +75,32 @@ class FilterScoreResult(NamedTuple):
     plugin_scores: Dict[str, jnp.ndarray]  # per-plugin weighted [B, N]
 
 
+def _filter_mask(name: str, cluster, batch, cfg: ProgramConfig, affinity_ok):
+    """One filter plugin's pass mask [B, N]; returns (ok, extra_unresolvable
+    or None)."""
+    if name == "NodeUnschedulable":
+        return K.node_unschedulable_filter(cluster, batch), None
+    if name == "NodeResourcesFit":
+        return K.fit_filter(cluster, batch), None
+    if name == "NodeName":
+        return K.node_name_filter(cluster, batch), None
+    if name == "NodePorts":
+        return K.node_ports_filter(cluster, batch), None
+    if name == "NodeAffinity":
+        return affinity_ok, None
+    if name == "TaintToleration":
+        return K.taint_filter(cluster, batch), None
+    if name == "PodTopologySpread":
+        return K.spread_filter(cluster, batch, affinity_ok), None
+    if name == "InterPodAffinity":
+        ok, aff_unres = K.interpod_filter(cluster, batch)
+        return ok, aff_unres
+    if name == "NodeLabel":
+        present, absent, _ = cfg.arg("NodeLabel", ((), (), ()))
+        return K.node_label_filter(cluster, batch, present, absent), None
+    raise ValueError(f"unknown filter kernel {name}")
+
+
 def run_filters(cluster, batch, cfg: ProgramConfig, host_ok=None,
                 skip: Tuple[str, ...] = ()):
     """Returns (feasible, unresolvable, node_affinity_ok).  host_ok [B, N]
@@ -93,32 +119,47 @@ def run_filters(cluster, batch, cfg: ProgramConfig, host_ok=None,
     for name in cfg.filters:
         if name in skip:
             continue
-        if name == "NodeUnschedulable":
-            ok = K.node_unschedulable_filter(cluster, batch)
-        elif name == "NodeResourcesFit":
-            ok = K.fit_filter(cluster, batch)
-        elif name == "NodeName":
-            ok = K.node_name_filter(cluster, batch)
-        elif name == "NodePorts":
-            ok = K.node_ports_filter(cluster, batch)
-        elif name == "NodeAffinity":
-            ok = affinity_ok
-        elif name == "TaintToleration":
-            ok = K.taint_filter(cluster, batch)
-        elif name == "PodTopologySpread":
-            ok = K.spread_filter(cluster, batch, affinity_ok)
-        elif name == "InterPodAffinity":
-            ok, aff_unres = K.interpod_filter(cluster, batch)
-            unresolvable = unresolvable | (aff_unres & base)
-        elif name == "NodeLabel":
-            present, absent, _ = cfg.arg("NodeLabel", ((), (), ()))
-            ok = K.node_label_filter(cluster, batch, present, absent)
-        else:
-            raise ValueError(f"unknown filter kernel {name}")
+        ok, extra_unres = _filter_mask(name, cluster, batch, cfg, affinity_ok)
+        if extra_unres is not None:
+            unresolvable = unresolvable | (extra_unres & base)
         if name in UNRESOLVABLE_FILTERS:
             unresolvable = unresolvable | (~ok & base)
         feasible = feasible & ok
     return feasible, unresolvable, affinity_ok
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def explain_filters(cluster, batch, cfg: ProgramConfig, host_ok=None):
+    """Per-filter unschedulability attribution for diagnostics/benchmarks
+    (the tensor analog of the reference's per-node FailedPredicates map,
+    core/generic_scheduler.go:565 podPassesFiltersOnNode status collection).
+
+    For every pod with no feasible node, a filter is *blocking* when every
+    node that passes all OTHER filters fails it.  Returns (no_feasible [B]
+    bool, blocking [F, B] bool) with F = len(cfg.filters), evaluated against
+    this snapshot."""
+    from .batch import densify_for
+    batch = densify_for(cluster, batch)
+    base = cluster.node_valid[None, :] & batch.valid[:, None]
+    if host_ok is not None:
+        base = base & host_ok
+    affinity_ok = K.node_affinity_filter(cluster, batch)
+    masks = [
+        _filter_mask(name, cluster, batch, cfg, affinity_ok)[0] & base
+        for name in cfg.filters]
+    all_ok = base
+    for m in masks:
+        all_ok = all_ok & m
+    no_feasible = ~jnp.any(all_ok, axis=1) & batch.valid
+    blocking = []
+    for i in range(len(masks)):
+        others = base
+        for j, m in enumerate(masks):
+            if j != i:
+                others = others & m
+        blocked = jnp.any(others, axis=1) & ~jnp.any(others & masks[i], axis=1)
+        blocking.append(blocked & no_feasible)
+    return no_feasible, jnp.stack(blocking)
 
 
 def run_scores(cluster, batch, cfg: ProgramConfig, feasible, affinity_ok):

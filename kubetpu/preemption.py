@@ -4,23 +4,37 @@ reference: pkg/scheduler/core/generic_scheduler.go — Preempt :252,
 podEligibleToPreemptOthers :1063, nodesWherePreemptionMightHelp :1041,
 selectNodesForPreemption :858, selectVictimsOnNode :949 (clone + remove
 lower-priority pods + re-run filters + reprieve by PDB then priority),
-pickOneNodeForPreemption :729 (6-criteria lexicographic tie-break),
-getLowerPriorityNominatedPods :360; invoked from scheduler.go:391 preempt.
+processPreemptionWithExtenders :317, pickOneNodeForPreemption :729
+(6-criteria lexicographic tie-break); invoked from scheduler.go:391 preempt.
 
 TPU shape of the what-if: the reference clones one NodeInfo per candidate
-and re-runs all filter plugins against it.  Here the clone is a *mask
-flip*: victims are existing-pod rows in the already-built cluster tensors,
-so "remove the victims of node n" = clear their pod_valid bits and subtract
-their resource rows — then ONE jitted filter pass answers "does the pod now
-fit on n".  The candidate scan batches those passes; the data-dependent
-reprieve loop (:1004-1037) stays host-side, exactly as SURVEY.md §7 planned.
+and serially re-runs all filter plugins per victim add-back — an
+O(candidates x victims) host loop.  Here the candidate axis is vmapped:
+every candidate's what-if state is the shared cycle snapshot plus a
+per-candidate delta (its own victims' pod rows masked out, their resources
+subtracted from its own node row), and ONE jitted pass answers "does the
+pod now fit" for ALL candidates at once.  The reprieve loop becomes a
+lax.scan over add-back depth: step k tries every candidate's k-th victim
+(PDB-violating first, then by descending priority — :1004-1037)
+simultaneously, so total device passes per preemption = reprieve depth + 1,
+independent of the candidate count.
+
+The cycle's snapshot tensors are reused (reference Preempt reuses the
+Schedule call's nodeInfoSnapshot); nothing is re-tensorized per failed pod.
+
+Host-filter deviation: volume-type (host) filters are validated against the
+final victim-adjusted NodeInfo instead of inside every reprieve step — the
+device reprieve covers all tensor filters; a host filter can therefore only
+differ from the reference on a mid-reprieve add-back whose feasibility
+flips on volumes alone.
 """
 
 from __future__ import annotations
 
-import copy
-from typing import Dict, List, Optional, Sequence, Tuple
+import functools
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
+import jax
 import numpy as np
 
 from .api import types as api
@@ -29,6 +43,7 @@ from .framework.types import NodeInfo, PodInfo
 from .models import programs
 from .models.batch import PodBatchBuilder
 from .state.tensors import MIB, CH_PODS, SnapshotBuilder
+from .utils.intern import pow2_bucket
 
 
 class Victims:
@@ -39,30 +54,133 @@ class Victims:
         self.num_pdb_violations = num_pdb_violations
 
 
+class CycleContext:
+    """Per-cycle tensors the scheduler shares with preemption (reference:
+    Preempt runs against the same g.nodeInfoSnapshot as Schedule).  Also
+    caches per-pod feasibility rows so N failed pods cost ONE candidates
+    pass, not N."""
+
+    def __init__(self, builder: SnapshotBuilder, cluster, cfg,
+                 node_infos: Sequence[NodeInfo], batch=None,
+                 row_of: Optional[Dict[str, int]] = None,
+                 feasible=None, unresolvable=None):
+        self.builder = builder
+        self.cluster = cluster
+        self.cfg = cfg
+        self.node_infos = node_infos
+        self.batch = batch           # the cycle's PodBatch (all live pods)
+        self.row_of = row_of or {}   # pod uid -> batch row
+        self.feasible = feasible     # [B, N] np.ndarray or None
+        self.unresolvable = unresolvable
+
+    def pod_verdicts(self, pod_uid: str):
+        """(feasible_row, unresolvable_row) for a cycle pod, computing the
+        whole-batch filter pass lazily on first use (one device call shared
+        by every preemption attempt this cycle)."""
+        row = self.row_of.get(pod_uid)
+        if row is None:
+            return None
+        if self.feasible is None:
+            if self.batch is None:
+                return None
+            res = programs.filter_and_score(self.cluster, self.batch,
+                                            self.cfg)
+            self.feasible = np.asarray(res.feasible)
+            self.unresolvable = np.asarray(res.unresolvable)
+        return self.feasible[row], self.unresolvable[row]
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _whatif_reprieve(cluster, batch1, cfg, cand_rows, rm_valid, rm_req,
+                     rm_nz, vic_row, vic_req, vic_nz):
+    """Batched selectVictimsOnNode (generic_scheduler.go:949).
+
+    cand_rows [C]        candidate node rows
+    rm_valid  [C, P]     pod_valid with ALL of each candidate's lower-priority
+                         pods masked out
+    rm_req    [C, R]     summed resources of those pods (per own node row)
+    rm_nz     [C, 2]     their non-zero-request sums
+    vic_row   [C, K]     victim pod rows in reprieve order (-1 pad)
+    vic_req   [C, K, R]  per-victim resources
+    vic_nz    [C, K, 2]
+
+    Returns (fits0 [C] — pod fits with all victims removed,
+             reprieved [K, C] — victim k stayed on the node)."""
+    import jax.numpy as jnp
+
+    from .models.batch import densify_for
+    batch1 = densify_for(cluster, batch1)
+    C = cand_rows.shape[0]
+    K = vic_row.shape[1]
+    base_req = cluster.requested
+    base_nz = cluster.nonzero_requested
+
+    def one(pod_valid, dreq, dnz, row):
+        cl = cluster._replace(
+            pod_valid=pod_valid,
+            requested=base_req.at[row].add(-dreq),
+            nonzero_requested=base_nz.at[row].add(-dnz))
+        feas, _, _ = programs.run_filters(cl, batch1, cfg)
+        return feas[0]  # [N]
+
+    vfilter = jax.vmap(one, in_axes=(0, 0, 0, 0))
+
+    def verdicts(pod_valid, dreq, dnz):
+        feas = vfilter(pod_valid, dreq, dnz, cand_rows)       # [C, N]
+        return jnp.take_along_axis(feas, cand_rows[:, None], 1)[:, 0]
+
+    fits0 = verdicts(rm_valid, rm_req, rm_nz)
+
+    def step(carry, k):
+        pod_valid, dreq, dnz, ok = carry
+        row = vic_row[:, k]                                   # [C]
+        exists = (row >= 0) & ok
+        e = exists.astype(jnp.float32)
+        try_valid = pod_valid.at[jnp.arange(C), jnp.clip(row, 0)].max(exists)
+        try_dreq = dreq - vic_req[:, k] * e[:, None]
+        try_dnz = dnz - vic_nz[:, k] * e[:, None]
+        fit = verdicts(try_valid, try_dreq, try_dnz) & exists
+        keep = fit[:, None]
+        pod_valid = jnp.where(keep, try_valid, pod_valid)
+        dreq = jnp.where(keep, try_dreq, dreq)
+        dnz = jnp.where(keep, try_dnz, dnz)
+        return (pod_valid, dreq, dnz, ok), fit
+
+    (_, _, _, _), reprieved = jax.lax.scan(
+        step, (rm_valid, rm_req, rm_nz, fits0), jnp.arange(K))
+    return fits0, reprieved
+
+
 class Preemptor:
-    def __init__(self, scheduler, max_detailed_candidates: int = 16):
+    def __init__(self, scheduler, max_candidates: int = 2048):
         self.sched = scheduler
-        self.max_detailed_candidates = max_detailed_candidates
+        # memory bound on the vmapped candidate axis, NOT the reference's
+        # behavior — when exceeded, candidates are pre-ranked and trimmed
+        self.max_candidates = max_candidates
 
     # ------------------------------------------------------------------ entry
 
-    def preempt(self, fwk, state: CycleState, pod: api.Pod) -> Optional[str]:
+    def preempt(self, fwk, state: CycleState, pod: api.Pod,
+                cycle: Optional[CycleContext] = None) -> Optional[str]:
         """reference: scheduler.go:391 + generic_scheduler.go:252 Preempt.
         Returns the nominated node name, or None."""
         sched = self.sched
         pod = sched.store.get_pod(pod.namespace, pod.metadata.name) or pod
         if not self._eligible(pod):
             return None
-        sched.cache.update_snapshot(sched.snapshot)
-        node_infos = sched.snapshot.node_info_list
+        if cycle is None:
+            cycle = self._build_cycle(fwk, pod)
+        node_infos = cycle.node_infos
         if not node_infos:
             return None
 
-        cand = self._nodes_where_preemption_might_help(fwk, pod, node_infos)
+        cand = self._nodes_where_preemption_might_help(fwk, pod, cycle)
         if not cand:
             return None
         pdbs = sched.store.list("PodDisruptionBudget")
-        node_victims = self._select_nodes_for_preemption(fwk, pod, cand, pdbs)
+        node_victims = self._select_nodes_for_preemption(fwk, pod, cand,
+                                                         pdbs, cycle)
+        node_victims = self._process_with_extenders(pod, node_victims)
         if not node_victims:
             return None
         best = pick_one_node_for_preemption(node_victims)
@@ -108,144 +226,212 @@ class Preemptor:
                 return False
         return True
 
-    # ------------------------------------------------------- candidate nodes
+    # ------------------------------------------------------------ cycle state
 
-    def _nodes_where_preemption_might_help(self, fwk, pod: api.Pod,
-                                           node_infos: Sequence[NodeInfo]):
-        """reference: generic_scheduler.go:1041 — skip nodes whose failure
-        was UnschedulableAndUnresolvable.  One device pass recovers the
-        per-node unresolvable verdicts."""
-        import jax
+    def _build_cycle(self, fwk, pod: api.Pod) -> CycleContext:
+        """Fallback when no cycle tensors were handed over (direct calls,
+        extender path)."""
+        sched = self.sched
+        sched.cache.update_snapshot(sched.snapshot)
+        node_infos = list(sched.snapshot.node_info_list)
         builder = SnapshotBuilder(
             hard_pod_affinity_weight=fwk.hard_pod_affinity_weight)
-        pinfos = [PodInfo(pod)]
-        builder.intern_pending(pinfos)
-        host = builder.build(list(node_infos))
-        cluster = host.to_device()
-        pb = PodBatchBuilder(builder.table)
-        batch = jax.tree.map(np.asarray, pb.build(
-            pinfos,
-            spread_selectors=[self.sched.store.default_spread_selector(pod)]))
+        builder.intern_pending([PodInfo(pod)])
+        cluster = builder.build(node_infos).to_device()
         cfg = programs.ProgramConfig(
             filters=fwk.tensor_filters, scores=fwk.tensor_scores,
             hostname_topokey=max(
                 builder.table.topokey.get(api.LABEL_HOSTNAME), 0),
             plugin_args=fwk.tensor_plugin_args(builder.table))
-        res = programs.filter_and_score(cluster, batch, cfg)
-        feasible = np.asarray(res.feasible)[0, :len(node_infos)]
-        unresolvable = np.asarray(res.unresolvable)[0, :len(node_infos)]
-        self._sim = (builder, host, pinfos, batch, cfg)  # reused by the sim
-        return [ni for ni, f, u in zip(node_infos, feasible, unresolvable)
+        return CycleContext(builder=builder, cluster=cluster, cfg=cfg,
+                            node_infos=node_infos)
+
+    def _pod_batch1(self, pod: api.Pod, cycle: CycleContext):
+        import jax
+        pb = PodBatchBuilder(cycle.builder.table)
+        sel = self.sched.store.default_spread_selector(pod)
+        return jax.tree.map(np.asarray,
+                            pb.build([PodInfo(pod)], spread_selectors=[sel]))
+
+    # ------------------------------------------------------- candidate nodes
+
+    def _nodes_where_preemption_might_help(self, fwk, pod: api.Pod,
+                                           cycle: CycleContext):
+        """reference: generic_scheduler.go:1041 — every failed node that is
+        not UnschedulableAndUnresolvable.  Host-filter failures count as
+        resolvable failures too (nodesWherePreemptionMightHelp considers
+        them), so host verdicts are ANDed into feasibility here."""
+        node_infos = cycle.node_infos
+        verdicts = cycle.pod_verdicts(pod.uid)
+        if verdicts is None:
+            batch1 = self._pod_batch1(pod, cycle)
+            res = programs.filter_and_score(cycle.cluster, batch1, cycle.cfg)
+            feasible = np.asarray(res.feasible)[0]
+            unresolvable = np.asarray(res.unresolvable)[0]
+        else:
+            feasible, unresolvable = verdicts
+        feasible = np.array(feasible[:len(node_infos)])
+        unresolvable = unresolvable[:len(node_infos)]
+        if fwk.has_relevant_host_filters(pod):
+            state = CycleState()
+            for j, ni in enumerate(node_infos):
+                if feasible[j]:
+                    st = fwk.run_filter_plugins(state, pod, ni)
+                    if not st.is_success():
+                        feasible[j] = False
+        self._batch1 = None  # built lazily when victims exist
+        return [(j, ni) for j, (ni, f, u) in
+                enumerate(zip(node_infos, feasible, unresolvable))
                 if not f and not u]
 
     # -------------------------------------------------------- victim search
 
     def _select_nodes_for_preemption(self, fwk, pod: api.Pod,
-                                     candidates: Sequence[NodeInfo],
-                                     pdbs) -> Dict[str, Victims]:
-        """reference: generic_scheduler.go:858 (parallel what-if).  Ranks
-        candidates by cheap host-side stats, then runs the detailed
-        (device-checked) simulation on the strongest few."""
+                                     candidates, pdbs,
+                                     cycle: CycleContext) -> Dict[str, Victims]:
+        """reference: generic_scheduler.go:858 selectNodesForPreemption —
+        the parallel what-if, here ONE batched device program over every
+        candidate (see _whatif_reprieve)."""
+        import jax.numpy as jnp
+
         prio = pod.priority()
-        with_victims = []
-        for ni in candidates:
-            lower = [pi.pod for pi in ni.pods if pi.pod.priority() < prio]
+        table = cycle.builder.table
+        R = cycle.cluster.requested.shape[1]
+        P = cycle.cluster.pod_valid.shape[0]
+
+        # per-candidate victim lists in reprieve order: PDB-violating first,
+        # each group by descending priority (:1004-1037)
+        entries = []  # (row, ordered victims [PodInfo], n_violating)
+        pod_rows = self._pod_rows(cycle)
+        for row, ni in candidates:
+            lower = [pi for pi in ni.pods if pi.pod.priority() < prio]
             if not lower:
                 continue
-            with_victims.append((ni, lower))
-        # cheap pre-rank approximating pickOneNode's criteria so the
-        # detailed cap keeps the likely winners
-        def rank(item):
-            ni, lower = item
-            return (max(p.priority() for p in lower),
-                    sum(p.priority() for p in lower), len(lower))
-        with_victims.sort(key=rank)
+            violating, non_violating = filter_pods_with_pdb_violation(
+                [pi.pod for pi in lower], pdbs)
+            vset = {p.uid for p in violating}
+            lv = sorted((pi for pi in lower if pi.pod.uid in vset),
+                        key=lambda pi: -pi.pod.priority())
+            lnv = sorted((pi for pi in lower if pi.pod.uid not in vset),
+                         key=lambda pi: -pi.pod.priority())
+            entries.append((row, lv + lnv, len(lv)))
+        if not entries:
+            return {}
+        if len(entries) > self.max_candidates:
+            # memory cap: keep the candidates cheapest by pickOneNode-style
+            # stats (lowest max victim priority, then sum, then count)
+            def rank(e):
+                vs = e[1]
+                return (max(pi.pod.priority() for pi in vs),
+                        sum(pi.pod.priority() for pi in vs), len(vs))
+            entries = sorted(entries, key=rank)[: self.max_candidates]
+
+        C = pow2_bucket(len(entries), 1)
+        K = pow2_bucket(max(len(e[1]) for e in entries), 1)
+        cand_rows = np.zeros((C,), np.int32)
+        rm_valid = np.broadcast_to(
+            np.asarray(cycle.cluster.pod_valid), (C, P)).copy()
+        rm_req = np.zeros((C, R), np.float32)
+        rm_nz = np.zeros((C, 2), np.float32)
+        vic_row = np.full((C, K), -1, np.int32)
+        vic_req = np.zeros((C, K, R), np.float32)
+        vic_nz = np.zeros((C, K, 2), np.float32)
+        for c, (row, victims, _nv) in enumerate(entries):
+            cand_rows[c] = row
+            for k, pi in enumerate(victims):
+                prow = pod_rows.get(pi.pod.uid, -1)
+                if prow >= 0:
+                    rm_valid[c, prow] = False
+                vic_row[c, k] = prow
+                r = pi.resource
+                vr = np.zeros((R,), np.float32)
+                vr[0] = r.milli_cpu
+                vr[1] = r.memory / MIB
+                vr[2] = r.ephemeral_storage / MIB
+                vr[CH_PODS] = 1
+                for name, amt in r.scalar_resources.items():
+                    ch = table.rname.get(name)
+                    if ch >= 0:
+                        vr[4 + ch] = amt
+                vic_req[c, k] = vr
+                vic_nz[c, k, 0] = pi.non_zero_cpu
+                vic_nz[c, k, 1] = pi.non_zero_mem / MIB
+                rm_req[c] += vr
+                rm_nz[c] += vic_nz[c, k]
+        # pad rows: candidate 0's row with no removals (fits0 false unless
+        # genuinely feasible; padded candidates are dropped below)
+        for c in range(len(entries), C):
+            cand_rows[c] = entries[0][0]
+
+        if self._batch1 is None:
+            self._batch1 = self._pod_batch1(pod, cycle)
+        fits0, reprieved = _whatif_reprieve(
+            cycle.cluster, self._batch1, cycle.cfg,
+            jnp.asarray(cand_rows), jnp.asarray(rm_valid),
+            jnp.asarray(rm_req), jnp.asarray(rm_nz), jnp.asarray(vic_row),
+            jnp.asarray(vic_req), jnp.asarray(vic_nz))
+        fits0 = np.asarray(fits0)
+        reprieved = np.asarray(reprieved)  # [K, C]
+
         out: Dict[str, Victims] = {}
-        for ni, lower in with_victims[: self.max_detailed_candidates]:
-            v = self._select_victims_on_node(fwk, pod, ni, lower, pdbs)
-            if v is not None:
-                out[ni.node_name] = v
+        for c, (row, victims, n_violating) in enumerate(entries):
+            if not fits0[c]:
+                continue
+            final = [victims[k].pod for k in range(len(victims))
+                     if not reprieved[k, c]]
+            num_viol = sum(1 for k in range(min(n_violating, len(victims)))
+                           if not reprieved[k, c])
+            ni = cycle.node_infos[row]
+            if not self._host_filters_pass(fwk, pod, ni,
+                                           {p.uid for p in final}):
+                continue
+            out[ni.node_name] = Victims(pods=final,
+                                        num_pdb_violations=num_viol)
         return out
 
-    def _select_victims_on_node(self, fwk, pod: api.Pod, ni: NodeInfo,
-                                lower: List[api.Pod], pdbs) -> Optional[Victims]:
-        """reference: generic_scheduler.go:949 selectVictimsOnNode."""
-        node_row = self._node_row(ni)
-        removed = set(p.uid for p in lower)
-        if not self._fits(fwk, pod, ni, node_row, removed):
-            return None
-        violating, non_violating = filter_pods_with_pdb_violation(lower, pdbs)
-
-        victims: List[api.Pod] = []
-        num_violating = 0
-
-        def reprieve(p: api.Pod) -> bool:
-            # try adding p back; keep it if the pod still fits
-            removed.discard(p.uid)
-            if self._fits(fwk, pod, ni, node_row, removed):
-                return True
-            removed.add(p.uid)
-            victims.append(p)
-            return False
-
-        # reprieve in priority order, PDB-violating pods first
-        # (reference: :1004-1037)
-        for p in sorted(violating, key=lambda x: -x.priority()):
-            if not reprieve(p):
-                num_violating += 1
-        for p in sorted(non_violating, key=lambda x: -x.priority()):
-            reprieve(p)
-        return Victims(pods=victims, num_pdb_violations=num_violating)
-
-    # ------------------------------------------------------- device what-if
-
-    def _node_row(self, ni: NodeInfo) -> int:
-        for i, other in enumerate(self.sched.snapshot.node_info_list):
-            if other.node_name == ni.node_name:
-                return i
-        raise KeyError(ni.node_name)
-
-    def _fits(self, fwk, pod: api.Pod, ni: NodeInfo, node_row: int,
-              removed_uids: set) -> bool:
-        """Does `pod` pass all tensor filters on node `node_row` with the
-        given pods removed?  One B=1 jitted pass over mask-flipped tensors
-        (the clone-free NodeInfo.Clone of generic_scheduler.go:871)."""
-        import jax
-        builder, host, pinfos, batch, cfg = self._sim
-        d = dict(host.arrays)
-        pod_valid = d["pod_valid"].copy()
-        req = d["requested"].copy()
-        nz = d["nonzero_requested"].copy()
-        # find victim rows: existing pods of this node with removed uids
+    def _pod_rows(self, cycle: CycleContext) -> Dict[str, int]:
+        """pod uid -> existing-pod tensor row (build order of
+        state/tensors.py SnapshotBuilder.build)."""
+        rows: Dict[str, int] = {}
         row = 0
-        for n_idx, ninfo in enumerate(self.sched.snapshot.node_info_list):
-            for pi in ninfo.pods:
-                if n_idx == node_row and pi.pod.uid in removed_uids:
-                    pod_valid[row] = False
-                    r = pi.resource
-                    req[node_row, 0] -= r.milli_cpu
-                    req[node_row, 1] -= r.memory / MIB
-                    req[node_row, 2] -= r.ephemeral_storage / MIB
-                    req[node_row, CH_PODS] -= 1
-                    nz[node_row, 0] -= pi.non_zero_cpu
-                    nz[node_row, 1] -= pi.non_zero_mem / MIB
+        for ni in cycle.node_infos:
+            for pi in ni.pods:
+                rows[pi.pod.uid] = row
                 row += 1
-        d["pod_valid"] = pod_valid
-        d["requested"] = req
-        d["nonzero_requested"] = nz
-        from .state.tensors import HostClusterArrays
-        cluster = HostClusterArrays(arrays=d).to_device()
-        # host filters must also pass on the victim-adjusted node
-        if fwk.has_relevant_host_filters(pod):
-            sim_ni = ni.clone()
-            for pi in list(sim_ni.pods):
-                if pi.pod.uid in removed_uids:
-                    sim_ni.remove_pod(pi.pod)
-            st = fwk.run_filter_plugins(CycleState(), pod, sim_ni)
-            if not st.is_success():
-                return False
-        res = programs.filter_and_score(cluster, batch, cfg)
-        return bool(np.asarray(res.feasible)[0, node_row])
+        return rows
+
+    def _host_filters_pass(self, fwk, pod: api.Pod, ni: NodeInfo,
+                           removed_uids: set) -> bool:
+        if not fwk.has_relevant_host_filters(pod):
+            return True
+        sim_ni = ni.clone()
+        for pi in list(sim_ni.pods):
+            if pi.pod.uid in removed_uids:
+                sim_ni.remove_pod(pi.pod)
+        st = fwk.run_filter_plugins(CycleState(), pod, sim_ni)
+        return st.is_success()
+
+    # ------------------------------------------------------------- extenders
+
+    def _process_with_extenders(self, pod: api.Pod,
+                                node_victims: Dict[str, Victims]
+                                ) -> Dict[str, Victims]:
+        """reference: generic_scheduler.go:317 processPreemptionWithExtenders
+        + core/extender.go:317 ProcessPreemption."""
+        if not node_victims:
+            return node_victims
+        for ext in self.sched.extenders:
+            if not (ext.supports_preemption() and ext.is_interested(pod)):
+                continue
+            try:
+                node_victims = ext.process_preemption(pod, node_victims)
+            except Exception:
+                if getattr(ext, "ignorable", False):
+                    continue
+                return {}
+            if not node_victims:
+                return {}
+        return node_victims
 
 
 # ---------------------------------------------------------------------------

@@ -319,18 +319,33 @@ class Scheduler:
             filters=fwk.tensor_filters, scores=fwk.tensor_scores,
             hostname_topokey=max(builder.table.topokey.get(api.LABEL_HOSTNAME), 0),
             plugin_args=fwk.tensor_plugin_args(builder.table))
+        from .preemption import CycleContext
+        cycle_ctx = CycleContext(
+            builder=builder, cluster=cluster, cfg=cfg,
+            node_infos=node_infos, batch=batch,
+            row_of={qp.pod.uid: i for i, qp in enumerate(live)})
         trace.step("Tensorizing snapshot and pod batch done")
 
         if self.extenders:
             return outcomes + self._schedule_with_extenders(
                 fwk, live, states, node_infos, cluster, batch, cfg,
-                host_ok if any_host else None)
+                host_ok if any_host else None, cycle_ctx)
 
-        # ---- device: one scan for the whole group
-        res = schedule_sequential(
-            cluster, batch, cfg, self._next_rng(),
-            hard_pod_affinity_weight=float(fwk.hard_pod_affinity_weight),
-            host_ok=self._jax.numpy.asarray(host_ok) if any_host else None)
+        # ---- device: one program for the whole group (scan or auction)
+        if self.config.mode == "gang":
+            from .models.gang import schedule_gang
+            res = schedule_gang(
+                cluster, batch, cfg, self._next_rng(),
+                host_ok=self._jax.numpy.asarray(host_ok) if any_host else None)
+            # the auction already produced per-pod verdict rows; share them
+            # so preemption skips its candidates pass entirely
+            cycle_ctx.feasible = np.asarray(res.feasible0)
+            cycle_ctx.unresolvable = np.asarray(res.unresolvable)
+        else:
+            res = schedule_sequential(
+                cluster, batch, cfg, self._next_rng(),
+                hard_pod_affinity_weight=float(fwk.hard_pod_affinity_weight),
+                host_ok=self._jax.numpy.asarray(host_ok) if any_host else None)
         chosen = np.asarray(res.chosen)[:len(live)]
         n_feas = np.asarray(res.n_feasible)[:len(live)]
         unres = np.asarray(res.all_unresolvable)[:len(live)]
@@ -343,7 +358,8 @@ class Scheduler:
                 outcomes.append(self._fail(
                     fwk, qp, state, "",
                     f"0/{n_nodes} nodes are available",
-                    preemption_may_help=not bool(unres[i])))
+                    preemption_may_help=not bool(unres[i]),
+                    cycle=cycle_ctx))
                 continue
             node_name = node_infos[int(chosen[i])].node_name
             outcome = self._commit(fwk, qp, state, node_name,
@@ -355,7 +371,7 @@ class Scheduler:
 
     def _schedule_with_extenders(self, fwk: Framework, live, states,
                                  node_infos, cluster, batch, cfg,
-                                 host_ok) -> List[ScheduleOutcome]:
+                                 host_ok, cycle_ctx=None) -> List[ScheduleOutcome]:
         """Extender path (reference: generic_scheduler.go:497
         findNodesThatPassExtenders + :674-706 extender Prioritize combine):
         one batch filter+score on device, then per pod the HTTP webhooks
@@ -393,7 +409,8 @@ class Scheduler:
                 continue
             if not names:
                 outcomes.append(self._fail(
-                    fwk, qp, state, "", f"0/{n_nodes} nodes are available"))
+                    fwk, qp, state, "", f"0/{n_nodes} nodes are available",
+                    cycle=cycle_ctx))
                 continue
             combined = {n: 0.0 for n in names}
             try:
@@ -528,13 +545,15 @@ class Scheduler:
 
     def _fail(self, fwk: Framework, qp: QueuedPodInfo, state: CycleState,
               node_name: str, message: str,
-              preemption_may_help: bool = True) -> ScheduleOutcome:
+              preemption_may_help: bool = True,
+              cycle=None) -> ScheduleOutcome:
         """reference: scheduler.go:391 recordSchedulingFailure +
         :542-563 (preemption trigger + requeue + condition patch)."""
         pod = qp.pod
         nominated = ""
         if preemption_may_help and self.preemptor is not None:
-            nominated = self.preemptor.preempt(fwk, state, pod) or ""
+            nominated = self.preemptor.preempt(fwk, state, pod,
+                                               cycle=cycle) or ""
         self._record_failure(fwk, qp, message, nominated)
         return ScheduleOutcome(pod=pod, node="", err=message,
                                preemption_may_help=preemption_may_help)
