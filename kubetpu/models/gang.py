@@ -38,11 +38,23 @@ Invariants:
   suffices, round 1 admits every pod at exactly the node the sequential
   replay picks under the same rng.
 
-Scope note: topology filters/scores (PodTopologySpread, InterPodAffinity)
-are evaluated against the snapshot plus the batch's committed *resource*
-usage, not against intra-batch topology-pair counts — gang mode trades the
-scan's serial topology carries for O(rounds) parallel passes.  Workloads
-where intra-batch topology interaction must be exact use the sequential
+Topology correctness (intra-batch): the batch's pods are appended to the
+snapshot's existing-pod axis once, and each round updates their
+pod_node/pod_valid from the carry, so PodTopologySpread and InterPodAffinity
+filters (and the topology scores) are re-evaluated against committed
+placements exactly — a pod admitted in round r sees every pod admitted in
+rounds < r the way the reference's serial loop sees previously bound pods
+(interpodaffinity/filtering.go:314, podtopologyspread/filtering.go:200).
+Admitted pods' own required anti-affinity terms are spliced into
+filter_terms so they repel later-round pods (the existing-pods direction).
+Within a round, a conservative same-topology-pair deferral keeps admission
+order safe: a pod with required topology terms is deferred to the next
+round if any earlier-index pod was admitted this round into a topo pair one
+of its term keys maps its proposal to (and any pod is deferred from a pair
+an earlier-admitted anti-affinity-active pod landed in); the next round then
+re-checks it against exact committed counts.  Deferral never blocks the
+first admitted pod, so progress is preserved.  Score staleness within a
+single round (not across rounds) is the remaining gap vs the sequential
 replay mode.
 """
 
@@ -55,6 +67,8 @@ import jax
 import jax.numpy as jnp
 
 from ..ops import kernels as K
+from ..ops.selectors import concat_selector_sets
+from ..state.tensors import ExistingTerms
 from .programs import ProgramConfig, run_filters, run_scores
 
 _f = K._f
@@ -84,6 +98,58 @@ def _segment_base(values: jnp.ndarray, is_start: jnp.ndarray) -> jnp.ndarray:
     return jax.lax.cummax(marked, axis=0)
 
 
+def _extend_cluster(cluster, batch):
+    """Append the batch's pods to the existing-pod axis (pod_node/pod_valid
+    are patched per round from the carry) and splice the batch pods' required
+    anti-affinity terms into filter_terms with owner rows P+j, so admitted
+    batch pods repel later pods exactly like bound existing pods
+    (interpodaffinity/filtering.go:166 getExistingAntiAffinityCounts)."""
+    B = batch.req.shape[0]
+    P = cluster.pod_valid.shape[0]
+    raa = batch.raa
+    Ta = raa.valid.shape[1]
+    TK = cluster.topo_pair.shape[1]
+    ft = cluster.filter_terms
+    topo_key = raa.topo_key.reshape(-1)
+    # a term whose topology key exists nowhere in the cluster can never
+    # produce a pair, so it never fails anything — drop it
+    valid = (raa.valid & raa.topo_known
+             & (raa.topo_key < TK)).reshape(-1)
+    ext_terms = ExistingTerms(
+        sel=concat_selector_sets(ft.sel, raa.sel),
+        ns_hot=jnp.concatenate([ft.ns_hot, raa.ns_hot.reshape(B * Ta, -1)]),
+        topo_key=jnp.concatenate([ft.topo_key, topo_key]),
+        pod_idx=jnp.concatenate(
+            [ft.pod_idx, P + jnp.repeat(jnp.arange(B, dtype=jnp.int32), Ta)]),
+        weight=jnp.concatenate([ft.weight, jnp.ones((B * Ta,), jnp.float32)]),
+        valid=jnp.concatenate([ft.valid, valid]),
+    )
+    return cluster._replace(
+        pod_kv=jnp.concatenate([cluster.pod_kv, batch.kv_hot]),
+        pod_key=jnp.concatenate([cluster.pod_key, batch.key_hot]),
+        pod_ns_hot=jnp.concatenate([cluster.pod_ns_hot, batch.ns_hot]),
+        pod_node=jnp.concatenate(
+            [cluster.pod_node, jnp.full((B,), -1, jnp.int32)]),
+        pod_valid=jnp.concatenate(
+            [cluster.pod_valid, jnp.zeros((B,), bool)]),
+        pod_terminating=jnp.concatenate(
+            [cluster.pod_terminating, jnp.zeros((B,), bool)]),
+        filter_terms=ext_terms,
+    )
+
+
+def _seg_prefix(e_sorted: jnp.ndarray, is_start: jnp.ndarray) -> jnp.ndarray:
+    """Exclusive per-segment prefix sums of [B, U] rows sorted by segment."""
+    cs = jnp.cumsum(e_sorted, axis=0)
+    excl = cs - e_sorted
+    return excl - _segment_base(excl, is_start)
+
+
+def _key_terms_mask(terms, k: int) -> jnp.ndarray:
+    """[B, T] bool — valid required terms on topology key k."""
+    return (terms.topo_key == k) & terms.valid & terms.topo_known
+
+
 def _fit_rows(req: jnp.ndarray, avail: jnp.ndarray) -> jnp.ndarray:
     """Per-row NodeResourcesFit verdict for request rows [B, R] against
     available rows [B, R] (fit.go:194-267 semantics: pod count always
@@ -101,10 +167,13 @@ def _fit_rows(req: jnp.ndarray, avail: jnp.ndarray) -> jnp.ndarray:
     return pods_ok & (zero_req | res_ok)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "max_rounds"))
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "max_rounds",
+                                    "intra_batch_topology"))
 def schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
                   host_ok: Optional[jnp.ndarray] = None,
-                  max_rounds: Optional[int] = None) -> GangResult:
+                  max_rounds: Optional[int] = None,
+                  intra_batch_topology: bool = True) -> GangResult:
     from .batch import densify_for
     batch = densify_for(cluster, batch)
     B = batch.req.shape[0]
@@ -114,15 +183,65 @@ def schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
     filters = set(cfg.filters)
     use_fit = "NodeResourcesFit" in filters
     use_ports = "NodePorts" in filters
+    # Topology filters move into the round body (evaluated against committed
+    # placements) when intra-batch topology is on; the host may pass
+    # intra_batch_topology=False for batches it knows carry no pod-topology
+    # terms, restoring the cheaper static evaluation.
+    use_sph = "PodTopologySpread" in filters and intra_batch_topology
+    use_ipa = "InterPodAffinity" in filters and intra_batch_topology
+    intra = use_sph or use_ipa
 
-    # Static filters once (everything but the capacity filters the rounds
-    # re-evaluate); unresolvable mask matches run_filters' full pass because
-    # neither Fit nor Ports is an UnschedulableAndUnresolvable filter.
-    static_ok, unresolvable, affinity_ok = run_filters(
-        cluster, batch, cfg, host_ok,
-        skip=("NodeResourcesFit", "NodePorts"))
+    skip = ["NodeResourcesFit", "NodePorts"]
+    if use_sph:
+        skip.append("PodTopologySpread")
+    if use_ipa:
+        skip.append("InterPodAffinity")
+    # Static filters once (everything the rounds don't re-evaluate);
+    # Fit/Ports are not UnschedulableAndUnresolvable filters and
+    # InterPodAffinity's unresolvable part is re-captured at round 0, so the
+    # final unresolvable mask matches run_filters' full pass.
+    static_ok, static_unres, affinity_ok = run_filters(
+        cluster, batch, cfg, host_ok, skip=tuple(skip))
+    base = cluster.node_valid[None, :] & batch.valid[:, None]
+    if host_ok is not None:
+        base = base & host_ok
     ports_ok0 = (K.node_ports_filter(cluster, batch) if use_ports
                  else jnp.ones((B, N), bool))
+
+    ext = _extend_cluster(cluster, batch) if intra else cluster
+    score_names = set(n for n, _ in cfg.scores)
+    score_pre = None
+    if intra:
+        # hoist every assignment-independent match matrix out of the round
+        # loop: only the segment/gather work that depends on the carry's
+        # assignments runs per round
+        sph_match = (K.spread_match_ns(ext, batch, batch.spread)
+                     if use_sph else None)
+        ipa_pre = K.interpod_filter_pre(ext, batch) if use_ipa else None
+        score_pre = {}
+        if "InterPodAffinity" in score_names:
+            score_pre["interpod_score"] = K.interpod_score_pre(ext, batch)
+        if "PodTopologySpread" in score_names:
+            score_pre["spread_soft"] = K.spread_match_ns(ext, batch,
+                                                         batch.spread_soft)
+        if "DefaultPodTopologySpread" in score_names:
+            score_pre["default_spread"] = K.default_spread_match_ns(ext,
+                                                                    batch)
+    if use_ipa:
+        from ..ops.selectors import match_selectors_unique
+        has_ra = jnp.any(batch.ra.valid, axis=1)
+        ra_boot = (jnp.all(batch.ra.self_match | ~batch.ra.valid, axis=1)
+                   & has_ra)
+        mu_raa = match_selectors_unique(batch.raa.sel, batch.kv_hot,
+                                        batch.key_hot)  # [Ur, B]
+        raa_uidx = jnp.asarray(batch.raa.sel.index).reshape(
+            B, batch.raa.valid.shape[1])
+    if use_sph:
+        from ..ops.selectors import match_selectors_unique
+        mu_sph = match_selectors_unique(batch.spread.sel, batch.kv_hot,
+                                        batch.key_hot)  # [Us, B]
+        sph_uidx = jnp.asarray(batch.spread.sel.index).reshape(
+            B, batch.spread.valid.shape[1])
 
     pod_idx = jnp.arange(B, dtype=jnp.int32)
     tie_keys = jax.vmap(lambda i: jax.random.fold_in(rng, i))(pod_idx)
@@ -135,33 +254,113 @@ def schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
         assigned=jnp.full((B,), -1, jnp.int32),
         win_score=jnp.zeros((B,), jnp.float32),
         feas0=jnp.zeros((B, N), bool),
+        unres=static_unres,
         rounds=jnp.int32(0),
         progress=jnp.bool_(True),
     )
 
-    def feasibility(c):
+    def cluster_at(c):
+        """The cluster as this round sees it: committed resource usage, and
+        (under intra) the batch's admitted pods live on the existing-pod
+        axis at their assigned nodes."""
+        cl = ext._replace(requested=c["req"], nonzero_requested=c["nz"])
+        if intra:
+            pod_node = jnp.concatenate([cluster.pod_node, c["assigned"]])
+            pod_valid = jnp.concatenate(
+                [cluster.pod_valid, (c["assigned"] >= 0) & batch.valid])
+            cl = cl._replace(pod_node=pod_node, pod_valid=pod_valid)
+        return cl
+
+    def feasibility(c, cl):
         feas = static_ok
+        aff_unres = None
+        if use_sph:
+            feas = feas & K.spread_filter(cl, batch, affinity_ok,
+                                          match_ns=sph_match)
+        if use_ipa:
+            ok, aff_unres = K.interpod_filter(cl, batch, pre=ipa_pre)
+            feas = feas & ok
         if use_fit:
-            cl = cluster._replace(requested=c["req"])
             feas = feas & K.fit_filter(cl, batch)
         if use_ports:
             batch_conf = jnp.einsum(
                 "bp,np->bn", batch.ports_hot, c["ports_used"],
                 preferred_element_type=jnp.float32) > 0.5
             feas = feas & ports_ok0 & ~batch_conf
-        return feas
+        return feas, aff_unres
+
+    def _rules_for(terms, mu, uidx, k, pair_ok, order, is_start, admit_cap,
+                   anti: bool):
+        """Selector-precise same-pair deferral for one term set x one key.
+        rule A: pod j defers iff an earlier-admitted pod in its landing pair
+        matches one of j's key-k term selectors.  rule B (anti only): pod j
+        defers iff it matches a key-k anti term of an earlier-admitted pod
+        in the same pair."""
+        key_terms = _key_terms_mask(terms, k)  # [B, T]
+        adm = _f(admit_cap & pair_ok)[:, None]
+        # events A: admitted pods as selector members
+        e_a = mu.T * adm                               # [B, U]
+        pref_a = jnp.zeros_like(e_a).at[order].set(
+            _seg_prefix(e_a[order], is_start))
+        hits = jnp.take_along_axis(pref_a, uidx, axis=1) > 0  # [B, T]
+        defer = jnp.any(hits & key_terms, axis=1) & pair_ok
+        if anti:
+            # events B: admitted pods registering their key-k selectors
+            reg = jnp.zeros_like(e_a).at[
+                jnp.arange(B)[:, None], uidx].max(_f(key_terms))
+            e_b = reg * adm
+            pref_b = jnp.zeros_like(e_b).at[order].set(
+                _seg_prefix(e_b[order], is_start))
+            defer = defer | (jnp.any((pref_b > 0) & mu.T, axis=1) & pair_ok)
+        return defer
+
+    def topology_deferral(admit_cap, prop):
+        """Selector-precise intra-round serialization: see module
+        docstring.  One stable sort by landing pair per topology key; the
+        per-pair exclusive prefix sums run in unique-selector space
+        (O(B x U) per key), so deferral only triggers on genuinely
+        interacting pods — not on mere pair co-occupancy."""
+        prop_safe = jnp.clip(prop, 0, N - 1)
+        is_prop = prop < N
+        defer = jnp.zeros((B,), bool)
+        TK = cluster.topo_pair.shape[1]
+        for k in range(TK):
+            pair_k = jnp.where(is_prop, cluster.topo_pair[prop_safe, k], -1)
+            pair_ok = pair_k >= 0
+            skey = jnp.where(pair_ok, pair_k, jnp.int32(2**30))
+            order = jnp.argsort(skey, stable=True)
+            spair = skey[order]
+            is_start = jnp.concatenate(
+                [jnp.ones((1,), bool), spair[1:] != spair[:-1]])
+            if use_ipa:
+                defer = defer | _rules_for(batch.raa, mu_raa, raa_uidx, k,
+                                           pair_ok, order, is_start,
+                                           admit_cap, anti=True)
+            if use_sph:
+                defer = defer | _rules_for(batch.spread, mu_sph, sph_uidx, k,
+                                           pair_ok, order, is_start,
+                                           admit_cap, anti=False)
+        if use_ipa:
+            # bootstrap rule: a pod eligible for the required-affinity
+            # self-match bootstrap (filtering.go:356) defers behind any
+            # admission, since a new match anywhere invalidates "no matches"
+            earlier_any = jnp.cumsum(_f(admit_cap)) - _f(admit_cap)
+            defer = defer | (ra_boot & (earlier_any > 0))
+        return defer
 
     def cond(c):
         return c["progress"] & (c["rounds"] < max_rounds)
 
     def body(c):
         unassigned = (c["assigned"] < 0) & batch.valid
-        feas = feasibility(c) & unassigned[:, None]
+        cl = cluster_at(c)
+        feas, aff_unres = feasibility(c, cl)
+        feas = feas & unassigned[:, None]
 
-        # scores against committed usage so later rounds see earlier rounds'
-        # placements (the batched analog of assume-before-next-pod)
-        cl = cluster._replace(requested=c["req"], nonzero_requested=c["nz"])
-        scores, _ = run_scores(cl, batch, cfg, feas, affinity_ok)
+        # scores against committed usage + placements so later rounds see
+        # earlier rounds' pods (the batched analog of assume-before-next-pod)
+        scores, _ = run_scores(cl, batch, cfg, feas, affinity_ok,
+                               pre=score_pre)
 
         masked = jnp.where(feas, scores, _NEG)
         best = jnp.max(masked, axis=1)
@@ -198,6 +397,10 @@ def schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
 
         admit_sorted = cap_ok & sactive & (snode < N)
         admit = jnp.zeros((B,), bool).at[order].set(admit_sorted)
+        if intra:
+            # intra-round topology serialization (conservative; deferred
+            # pods re-check against exact committed counts next round)
+            admit = admit & ~topology_deferral(admit, prop)
 
         # ---- commit ----
         seg = jnp.where(admit, prop, N)
@@ -217,14 +420,16 @@ def schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
         new["assigned"] = jnp.where(admit, prop, c["assigned"])
         new["win_score"] = jnp.where(admit, best, c["win_score"])
         new["feas0"] = jnp.where(c["rounds"] == 0, feas, c["feas0"])
+        if aff_unres is not None:
+            new["unres"] = jnp.where(c["rounds"] == 0,
+                                     c["unres"] | (aff_unres & base),
+                                     c["unres"])
         new["rounds"] = c["rounds"] + 1
         new["progress"] = jnp.any(admit)
         return new
 
     out = jax.lax.while_loop(cond, body, carry0)
-    base = cluster.node_valid[None, :] & batch.valid[:, None]
-    if host_ok is not None:
-        base = base & host_ok
+    unresolvable = out["unres"]
     all_unres = jnp.all(unresolvable | out["feas0"] | ~base, axis=1)
     return GangResult(chosen=out["assigned"], score=out["win_score"],
                       rounds=out["rounds"], requested=out["req"],

@@ -162,9 +162,14 @@ def explain_filters(cluster, batch, cfg: ProgramConfig, host_ok=None):
     return no_feasible, jnp.stack(blocking)
 
 
-def run_scores(cluster, batch, cfg: ProgramConfig, feasible, affinity_ok):
+def run_scores(cluster, batch, cfg: ProgramConfig, feasible, affinity_ok,
+               pre=None):
     """Per-plugin normalized scores x weight, summed
-    (reference: framework.go:579-656 RunScorePlugins)."""
+    (reference: framework.go:579-656 RunScorePlugins).  pre: optional dict
+    of precomputed assignment-independent match tensors (gang mode hoists
+    them out of its round loop): keys "interpod_score", "spread_soft",
+    "default_spread"."""
+    pre = pre or {}
     total = jnp.zeros(feasible.shape, jnp.float32)
     per_plugin: Dict[str, jnp.ndarray] = {}
     for name, weight in cfg.scores:
@@ -173,7 +178,8 @@ def run_scores(cluster, batch, cfg: ProgramConfig, feasible, affinity_ok):
         elif name == "ImageLocality":
             s = K.image_locality_score(cluster, batch)
         elif name == "InterPodAffinity":
-            s = K.interpod_score(cluster, batch, feasible)
+            s = K.interpod_score(cluster, batch, feasible,
+                                 pre=pre.get("interpod_score"))
         elif name == "NodeResourcesLeastAllocated":
             s = K.least_allocated_score(cluster, batch)
         elif name == "NodeResourcesMostAllocated":
@@ -185,9 +191,11 @@ def run_scores(cluster, batch, cfg: ProgramConfig, feasible, affinity_ok):
             s = K.prefer_avoid_pods_score(cluster, batch)
         elif name == "PodTopologySpread":
             s = K.spread_soft_score(cluster, batch, feasible, affinity_ok,
-                                    cfg.hostname_topokey)
+                                    cfg.hostname_topokey,
+                                    match_ns=pre.get("spread_soft"))
         elif name == "DefaultPodTopologySpread":
-            raw = K.default_spread_score(cluster, batch)
+            raw = K.default_spread_score(cluster, batch,
+                                         match_ns=pre.get("default_spread"))
             s = K.default_spread_normalize(cluster, batch, raw, feasible)
         elif name == "TaintToleration":
             s = K.default_normalize(K.taint_toleration_score(cluster, batch),

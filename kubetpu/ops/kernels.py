@@ -169,24 +169,36 @@ class SpreadState(NamedTuple):
     any_eligible: jnp.ndarray  # [B]
 
 
-def _spread_state(cluster, batch, constraints, affinity_ok, count_mask_nodes) -> SpreadState:
+def spread_match_ns(cluster, batch, constraints) -> jnp.ndarray:
+    """[B, C, P] constraint-selector x namespace match against the pod axis
+    — the assignment-independent part of _spread_state, precomputable once
+    for gang mode's per-round re-evaluation."""
+    B, C = constraints.topo_key.shape
+    m = match_selectors(constraints.sel, cluster.pod_kv, cluster.pod_key)
+    ns_ok = jnp.einsum("bn,pn->bp", batch.ns_hot, cluster.pod_ns_hot,
+                       preferred_element_type=jnp.float32) > 0.5
+    return m.reshape(B, C, -1) & ns_ok[:, None, :]
+
+
+def _spread_state(cluster, batch, constraints, affinity_ok, count_mask_nodes,
+                  match_ns=None) -> SpreadState:
     """Shared machinery of hard-filter and soft-score spreading.
 
     constraints: batch.spread or batch.spread_soft.
     count_mask_nodes: [B, N] bool — nodes whose pods are counted into pair
     sums (PreFilter counts every node's pods into registered pairs; PreScore
-    counts only affinity-matching nodes with all keys)."""
+    counts only affinity-matching nodes with all keys).
+    match_ns: optional precomputed spread_match_ns output."""
     B, C = constraints.topo_key.shape
     N = cluster.allocatable.shape[0]
     L = cluster.kv.shape[1]
 
     # matching existing pods: same namespace, selector, non-terminating
     # (reference: podtopologyspread/common.go:87 countPodsMatchSelector)
-    m = match_selectors(constraints.sel, cluster.pod_kv, cluster.pod_key)  # [B*C, P]
-    ns_ok = jnp.einsum("bn,pn->bp", batch.ns_hot, cluster.pod_ns_hot,
-                       preferred_element_type=jnp.float32) > 0.5
+    if match_ns is None:
+        match_ns = spread_match_ns(cluster, batch, constraints)
     countable = cluster.pod_valid & ~cluster.pod_terminating
-    m = m.reshape(B, C, -1) & ns_ok[:, None, :] & countable[None, None, :]
+    m = match_ns & countable[None, None, :]
     node_counts = per_node_counts(m.reshape(B * C, -1), cluster.pod_node,
                                   N).reshape(B, C, N)
 
@@ -211,14 +223,15 @@ def _spread_state(cluster, batch, constraints, affinity_ok, count_mask_nodes) ->
                        any_eligible=any_eligible)
 
 
-def spread_filter(cluster, batch, affinity_ok) -> jnp.ndarray:
+def spread_filter(cluster, batch, affinity_ok, match_ns=None) -> jnp.ndarray:
     """PodTopologySpread hard constraints
     (reference: podtopologyspread/filtering.go:200-283 calPreFilterState/Filter)."""
     cons = batch.spread
     B, C = cons.topo_key.shape
     N = cluster.allocatable.shape[0]
     st = _spread_state(cluster, batch, cons, affinity_ok,
-                       cluster.node_valid[None, :] & jnp.ones((B, N), bool))
+                       cluster.node_valid[None, :] & jnp.ones((B, N), bool),
+                       match_ns=match_ns)
     # min match per constraint over *registered* pairs
     big = jnp.float32(2**31)
     masked = jnp.where(st.registered, st.pair_counts, big)
@@ -236,7 +249,7 @@ def spread_filter(cluster, batch, affinity_ok) -> jnp.ndarray:
 
 
 def spread_soft_score(cluster, batch, feasible, affinity_ok,
-                      hostname_topokey: int) -> jnp.ndarray:
+                      hostname_topokey: int, match_ns=None) -> jnp.ndarray:
     """PodTopologySpread soft constraints scoring, already normalized
     (reference: podtopologyspread/scoring.go PreScore/Score/NormalizeScore)."""
     cons = batch.spread_soft
@@ -244,7 +257,8 @@ def spread_soft_score(cluster, batch, feasible, affinity_ok,
     N = cluster.allocatable.shape[0]
     count_nodes = affinity_ok & cluster.node_valid[None, :]
     # pairs are registered from *filtered* nodes only
-    st = _spread_state(cluster, batch, cons, feasible, count_nodes)
+    st = _spread_state(cluster, batch, cons, feasible, count_nodes,
+                       match_ns=match_ns)
     is_host = (cons.topo_key == hostname_topokey) & cons.topo_known
     valid = cons.valid
 
@@ -294,28 +308,65 @@ def spread_soft_score(cluster, batch, feasible, affinity_ok,
 # InterPodAffinity
 
 
-def _pod_term_matches(cluster, terms, B: int) -> jnp.ndarray:
-    """Match pod-side affinity terms against existing pods -> [B, T, P]."""
+def _pod_term_matches_static(cluster, terms, B: int) -> jnp.ndarray:
+    """Selector x namespace match of pod-side terms against the pod axis —
+    the assignment-independent part of _pod_term_matches -> [B, T, P]."""
     m = match_selectors(terms.sel, cluster.pod_kv, cluster.pod_key)  # [B*T, P]
     T = terms.valid.shape[1]
     m = m.reshape(B, T, -1)
     ns_ok = jnp.einsum("btn,pn->btp", terms.ns_hot, cluster.pod_ns_hot,
                        preferred_element_type=jnp.float32) > 0.5
-    return m & ns_ok & cluster.pod_valid[None, None, :]
+    return m & ns_ok
 
 
-def interpod_filter(cluster, batch) -> Tuple[jnp.ndarray, jnp.ndarray]:
+def _pod_term_matches(cluster, terms, B: int, pre=None) -> jnp.ndarray:
+    """Match pod-side affinity terms against existing pods -> [B, T, P]."""
+    if pre is None:
+        pre = _pod_term_matches_static(cluster, terms, B)
+    return pre & cluster.pod_valid[None, None, :]
+
+
+def existing_terms_match(terms, batch) -> jnp.ndarray:
+    """[Et, B] existing-pod term-selector x namespace x validity match
+    against the batch — assignment-independent."""
+    em = match_selectors(terms.sel, batch.kv_hot, batch.key_hot)
+    ens = jnp.einsum("en,bn->eb", terms.ns_hot, batch.ns_hot,
+                     preferred_element_type=jnp.float32) > 0.5
+    return em & ens & terms.valid[:, None]
+
+
+class InterpodPre(NamedTuple):
+    """Assignment-independent matches for interpod_filter, precomputable
+    once for gang mode's per-round re-evaluation."""
+    m_ra: jnp.ndarray   # [B, Tr, P]
+    m_raa: jnp.ndarray  # [B, Ta, P]
+    em: jnp.ndarray     # [Et, B]
+
+
+def interpod_filter_pre(cluster, batch) -> InterpodPre:
+    B = batch.req.shape[0]
+    return InterpodPre(
+        m_ra=_pod_term_matches_static(cluster, batch.ra, B),
+        m_raa=_pod_term_matches_static(cluster, batch.raa, B),
+        em=existing_terms_match(cluster.filter_terms, batch))
+
+
+def interpod_filter(cluster, batch,
+                    pre: InterpodPre | None = None
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """InterPodAffinity filter.  Returns (ok, affinity_unresolvable) where
     affinity_unresolvable marks required-affinity failures
     (UnschedulableAndUnresolvable, reference: filtering.go:371-396)."""
     B = batch.req.shape[0]
     N = cluster.allocatable.shape[0]
     L = cluster.kv.shape[1]
+    if pre is None:
+        pre = interpod_filter_pre(cluster, batch)
 
     # --- incoming required affinity (filtering.go:342 satisfyPodAffinity)
     ra = batch.ra
     Tr = ra.valid.shape[1]
-    m = _pod_term_matches(cluster, ra, B)  # [B, T, P]
+    m = _pod_term_matches(cluster, ra, B, pre=pre.m_ra)  # [B, T, P]
     match_all = jnp.all(m | ~ra.valid[:, :, None], axis=1)  # [B, P]
     has_ra = jnp.any(ra.valid, axis=1)  # [B]
     ep_pair = pod_topo_pairs(cluster, ra.topo_key.reshape(-1))  # [B*T, P]
@@ -337,7 +388,7 @@ def interpod_filter(cluster, batch) -> Tuple[jnp.ndarray, jnp.ndarray]:
     # --- incoming required anti-affinity (filtering.go:329 satisfyPodAntiAffinity)
     raa = batch.raa
     Ta = raa.valid.shape[1]
-    ma = _pod_term_matches(cluster, raa, B).reshape(B * Ta, -1)
+    ma = _pod_term_matches(cluster, raa, B, pre=pre.m_raa).reshape(B * Ta, -1)
     ep_pair_a = pod_topo_pairs(cluster, raa.topo_key.reshape(-1))
     pc_a = pair_scatter(ma, ep_pair_a, L)
     np_a = node_topo_pairs(cluster, raa.topo_key.reshape(-1))
@@ -348,10 +399,7 @@ def interpod_filter(cluster, batch) -> Tuple[jnp.ndarray, jnp.ndarray]:
     # --- existing pods' required anti-affinity
     # (filtering.go:314 satisfyExistingPodsAntiAffinity)
     ft = cluster.filter_terms
-    em = match_selectors(ft.sel, batch.kv_hot, batch.key_hot)  # [Et, B]
-    ens = jnp.einsum("en,bn->eb", ft.ns_hot, batch.ns_hot,
-                     preferred_element_type=jnp.float32) > 0.5
-    em = em & ens & ft.valid[:, None]
+    em = pre.em  # [Et, B]
     pod_topo = jnp.take(cluster.topo_pair, jnp.clip(cluster.pod_node, 0, None), axis=0)
     e_pair = jnp.take_along_axis(pod_topo[jnp.clip(ft.pod_idx, 0, None)],
                                  ft.topo_key[:, None], axis=1)[:, 0]  # [Et]
@@ -366,15 +414,30 @@ def interpod_filter(cluster, batch) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return ok, ~aff_ok
 
 
-def interpod_score(cluster, batch, feasible) -> jnp.ndarray:
+class InterpodScorePre(NamedTuple):
+    m_pref: jnp.ndarray  # [B, Tp, P]
+    em: jnp.ndarray      # [Es, B]
+
+
+def interpod_score_pre(cluster, batch) -> InterpodScorePre:
+    B = batch.req.shape[0]
+    return InterpodScorePre(
+        m_pref=_pod_term_matches_static(cluster, batch.pref, B),
+        em=existing_terms_match(cluster.score_terms, batch))
+
+
+def interpod_score(cluster, batch, feasible,
+                   pre: InterpodScorePre | None = None) -> jnp.ndarray:
     """InterPodAffinity scoring, already normalized (reference: scoring.go)."""
     B = batch.req.shape[0]
     L = cluster.kv.shape[1]
+    if pre is None:
+        pre = interpod_score_pre(cluster, batch)
 
     # incoming pod's preferred terms vs existing pods
     pt = batch.pref
     T = pt.valid.shape[1]
-    m = _pod_term_matches(cluster, pt, B)  # [B, T, P]
+    m = _pod_term_matches(cluster, pt, B, pre=pre.m_pref)  # [B, T, P]
     ep_pair = pod_topo_pairs(cluster, pt.topo_key.reshape(-1))  # [B*T, P]
     data = (_f(m) * pt.weight[:, :, None] * _f(pt.valid)[:, :, None])
     counts = pair_scatter(data.reshape(B * T, -1), ep_pair, L)
@@ -382,11 +445,8 @@ def interpod_score(cluster, batch, feasible) -> jnp.ndarray:
 
     # existing pods' terms vs incoming pod
     st = cluster.score_terms
-    em = match_selectors(st.sel, batch.kv_hot, batch.key_hot)  # [Es, B]
-    ens = jnp.einsum("en,bn->eb", st.ns_hot, batch.ns_hot,
-                     preferred_element_type=jnp.float32) > 0.5
     owner_ok = jnp.take(cluster.pod_valid, jnp.clip(st.pod_idx, 0, None))
-    em = _f(em & ens & st.valid[:, None] & owner_ok[:, None]) * st.weight[:, None]
+    em = _f(pre.em & owner_ok[:, None]) * st.weight[:, None]
     pod_topo = jnp.take(cluster.topo_pair, jnp.clip(cluster.pod_node, 0, None), axis=0)
     e_pair = jnp.take_along_axis(pod_topo[jnp.clip(st.pod_idx, 0, None)],
                                  st.topo_key[:, None], axis=1)[:, 0]
@@ -514,16 +574,24 @@ def prefer_avoid_pods_score(cluster, batch) -> jnp.ndarray:
     return jnp.where(avoided, 0.0, MAX_NODE_SCORE)
 
 
-def default_spread_score(cluster, batch) -> jnp.ndarray:
+def default_spread_match_ns(cluster, batch) -> jnp.ndarray:
+    """[B, P] DefaultPodTopologySpread selector x namespace match —
+    assignment-independent."""
+    m = match_selectors(batch.spread_selector, cluster.pod_kv, cluster.pod_key)
+    ns_ok = jnp.einsum("bn,pn->bp", batch.ns_hot, cluster.pod_ns_hot,
+                       preferred_element_type=jnp.float32) > 0.5
+    return m & ns_ok
+
+
+def default_spread_score(cluster, batch, match_ns=None) -> jnp.ndarray:
     """DefaultPodTopologySpread raw score: count of same-namespace,
     non-terminating pods on the node matched by the combined controller
     selector (reference: default_pod_topology_spread.go:74-97, 200-215)."""
     N = cluster.allocatable.shape[0]
-    m = match_selectors(batch.spread_selector, cluster.pod_kv, cluster.pod_key)
-    ns_ok = jnp.einsum("bn,pn->bp", batch.ns_hot, cluster.pod_ns_hot,
-                       preferred_element_type=jnp.float32) > 0.5
+    if match_ns is None:
+        match_ns = default_spread_match_ns(cluster, batch)
     countable = cluster.pod_valid & ~cluster.pod_terminating
-    m = m & ns_ok & countable[None, :]
+    m = match_ns & countable[None, :]
     counts = per_node_counts(m, cluster.pod_node, N)
     return jnp.where(batch.spread_skip[:, None], 0.0, counts)
 

@@ -77,6 +77,18 @@ def match_selectors(sel: SelectorSet,
     The two einsums are batched matmuls over the U unique selectors;
     per-slot results are a gather on the slot index.
     """
+    return jnp.take(match_selectors_unique(sel, kv, key, num), sel.index,
+                    axis=0)
+
+
+def match_selectors_unique(sel: SelectorSet,
+                           kv: jnp.ndarray,
+                           key: jnp.ndarray,
+                           num: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """The [U, M] unique-selector match matrix behind match_selectors;
+    slot s maps to row sel.index[s].  Consumers that aggregate per unique
+    selector (e.g. gang's intra-round deferral) use this directly to stay
+    O(U x M) instead of O(S x M)."""
     kv_f = kv.astype(jnp.float32)
     key_f = key.astype(jnp.float32)
     cnt_v = jnp.einsum("uql,ml->uqm", sel.vals_hot.astype(jnp.float32), kv_f,
@@ -97,8 +109,40 @@ def match_selectors(sel: SelectorSet,
         ok = jnp.where(sel.num_op[..., None] > 0, cmp, ok)
 
     ok = jnp.logical_or(ok, jnp.logical_not(sel.req_valid[..., None]))
-    uniq = jnp.logical_and(jnp.all(ok, axis=1), sel.sel_valid[:, None])
-    return jnp.take(uniq, sel.index, axis=0)
+    return jnp.logical_and(jnp.all(ok, axis=1), sel.sel_valid[:, None])
+
+
+def concat_selector_sets(a: SelectorSet, b: SelectorSet) -> SelectorSet:
+    """Concatenate two SelectorSets compiled against the SAME vocab (same
+    InternTable): unique rows are stacked (b's slot indices shifted), and the
+    requirement axis is padded to the larger Q.  Works on traced arrays, so
+    gang mode can splice batch-pod terms into the snapshot's ExistingTerms
+    inside jit."""
+    qa, qb = a.req_valid.shape[1], b.req_valid.shape[1]
+    q = max(qa, qb)
+
+    def padq(x, have):
+        if have == q:
+            return x
+        pad = [(0, 0)] * x.ndim
+        pad[1] = (0, q - have)
+        return jnp.pad(x, pad)
+
+    ua = a.sel_valid.shape[0]
+    return SelectorSet(
+        vals_hot=jnp.concatenate([padq(a.vals_hot, qa), padq(b.vals_hot, qb)]),
+        key_hot=jnp.concatenate([padq(a.key_hot, qa), padq(b.key_hot, qb)]),
+        negate=jnp.concatenate([padq(a.negate, qa), padq(b.negate, qb)]),
+        use_key=jnp.concatenate([padq(a.use_key, qa), padq(b.use_key, qb)]),
+        req_valid=jnp.concatenate([padq(a.req_valid, qa),
+                                   padq(b.req_valid, qb)]),
+        num_key=jnp.concatenate([padq(a.num_key, qa), padq(b.num_key, qb)]),
+        num_op=jnp.concatenate([padq(a.num_op, qa), padq(b.num_op, qb)]),
+        num_val=jnp.concatenate([padq(a.num_val, qa), padq(b.num_val, qb)]),
+        sel_valid=jnp.concatenate([a.sel_valid, b.sel_valid]),
+        index=jnp.concatenate([jnp.asarray(a.index),
+                               jnp.asarray(b.index) + ua]),
+    )
 
 
 # ---------------------------------------------------------------------------
