@@ -102,8 +102,14 @@ def explain(sched, outcomes):
     sb.intern_pending(pinfos)
     cluster = sb.build(sched.snapshot.node_info_list).to_device()
     batch = jax.tree.map(np.asarray, PodBatchBuilder(sb.table).build(pinfos))
+    # attribute against the ACTIVE profile's filter list with the hostname
+    # topo key (not the zone key) so attribution matches what actually
+    # blocked scheduling
+    fwk = next(iter(sched.profiles.values()))
     cfg = programs.ProgramConfig(
-        hostname_topokey=max(sb.table.topokey.get(api.LABEL_ZONE), 0))
+        filters=fwk.tensor_filters, scores=fwk.tensor_scores,
+        hostname_topokey=max(sb.table.topokey.get(api.LABEL_HOSTNAME), 0),
+        plugin_args=fwk.tensor_plugin_args(sb.table))
     no_feas, blocking = programs.explain_filters(cluster, batch, cfg)
     blocking = np.asarray(blocking)[:, :len(failed)]
     counts = {name: int(blocking[i].sum())

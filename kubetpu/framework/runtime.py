@@ -277,7 +277,9 @@ class Framework:
             if st.code == Code.SKIP:
                 continue
             return st
-        return Status(Code.SKIP)
+        return Status(Code.SKIP, [
+            f"all bind plugins skipped binding pod "
+            f"{pod.namespace}/{pod.metadata.name}"])
 
     def run_post_bind_plugins(self, state: CycleState, pod: api.Pod,
                               node_name: str) -> None:

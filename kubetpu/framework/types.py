@@ -168,11 +168,16 @@ class QueuedPodInfo:
     timestamp: float = field(default_factory=time.time)
     attempts: int = 0
     initial_attempt_timestamp: float = field(default_factory=time.time)
+    # queue.scheduling_cycle captured when this pod was popped (reference:
+    # scheduler.go:515 podSchedulingCycle := SchedulingQueue.SchedulingCycle()
+    # is read at pop time, not at failure time)
+    scheduling_cycle: int = 0
 
     def deep_copy(self) -> "QueuedPodInfo":
         return QueuedPodInfo(pod=self.pod, timestamp=self.timestamp,
                              attempts=self.attempts,
-                             initial_attempt_timestamp=self.initial_attempt_timestamp)
+                             initial_attempt_timestamp=self.initial_attempt_timestamp,
+                             scheduling_cycle=self.scheduling_cycle)
 
 
 # ---------------------------------------------------------------------------

@@ -67,7 +67,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops import kernels as K
-from ..ops.selectors import concat_selector_sets
+from ..ops.selectors import concat_selector_sets, match_selectors_unique
 from ..state.tensors import ExistingTerms
 from .programs import ProgramConfig, run_filters, run_scores
 
@@ -81,7 +81,10 @@ class GangResult(NamedTuple):
     rounds: jnp.ndarray     # i32 number of propose/admit rounds executed
     requested: jnp.ndarray  # [N, R] final requested incl. batch placements
     feasible0: jnp.ndarray  # [B, N] bool first-round feasibility (diagnostics)
-    unresolvable: jnp.ndarray  # [B, N] bool from the static filter pass
+    unresolvable: jnp.ndarray  # [B, N] bool — static filters plus the
+                            # InterPodAffinity required-affinity bits
+                            # re-captured at round 0 when intra-batch
+                            # topology moves that filter into the loop
     n_feasible: jnp.ndarray    # [B] i32 first-round feasible-node count
     all_unresolvable: jnp.ndarray  # [B] bool — every failed node failed
                             # UnschedulableAndUnresolvable (preemption gate,
@@ -228,7 +231,6 @@ def schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
             score_pre["default_spread"] = K.default_spread_match_ns(ext,
                                                                     batch)
     if use_ipa:
-        from ..ops.selectors import match_selectors_unique
         has_ra = jnp.any(batch.ra.valid, axis=1)
         ra_boot = (jnp.all(batch.ra.self_match | ~batch.ra.valid, axis=1)
                    & has_ra)
@@ -237,7 +239,6 @@ def schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
         raa_uidx = jnp.asarray(batch.raa.sel.index).reshape(
             B, batch.raa.valid.shape[1])
     if use_sph:
-        from ..ops.selectors import match_selectors_unique
         mu_sph = match_selectors_unique(batch.spread.sel, batch.kv_hot,
                                         batch.key_hot)  # [Us, B]
         sph_uidx = jnp.asarray(batch.spread.sel.index).reshape(
@@ -274,11 +275,13 @@ def schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
     def feasibility(c, cl):
         feas = static_ok
         aff_unres = None
+        boot_live = None
         if use_sph:
             feas = feas & K.spread_filter(cl, batch, affinity_ok,
                                           match_ns=sph_match)
         if use_ipa:
-            ok, aff_unres = K.interpod_filter(cl, batch, pre=ipa_pre)
+            ok, aff_unres, boot_live = K.interpod_filter(
+                cl, batch, pre=ipa_pre, return_no_matches=True)
             feas = feas & ok
         if use_fit:
             feas = feas & K.fit_filter(cl, batch)
@@ -287,7 +290,7 @@ def schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
                 "bp,np->bn", batch.ports_hot, c["ports_used"],
                 preferred_element_type=jnp.float32) > 0.5
             feas = feas & ports_ok0 & ~batch_conf
-        return feas, aff_unres
+        return feas, aff_unres, boot_live
 
     def _rules_for(terms, mu, uidx, k, pair_ok, order, is_start, admit_cap,
                    anti: bool):
@@ -314,7 +317,7 @@ def schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
             defer = defer | (jnp.any((pref_b > 0) & mu.T, axis=1) & pair_ok)
         return defer
 
-    def topology_deferral(admit_cap, prop):
+    def topology_deferral(admit_cap, prop, boot_live):
         """Selector-precise intra-round serialization: see module
         docstring.  One stable sort by landing pair per topology key; the
         per-pair exclusive prefix sums run in unique-selector space
@@ -341,11 +344,16 @@ def schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
                                            pair_ok, order, is_start,
                                            admit_cap, anti=False)
         if use_ipa:
-            # bootstrap rule: a pod eligible for the required-affinity
-            # self-match bootstrap (filtering.go:356) defers behind any
-            # admission, since a new match anywhere invalidates "no matches"
+            # bootstrap rule: a pod whose required-affinity terms match
+            # nothing THIS round is admitted only via the self-match
+            # bootstrap (filtering.go:356); any same-round admission could
+            # create a match and invalidate "no matches", so it defers
+            # behind any earlier admission.  Once matches exist the normal
+            # count path applies and co-admission is monotone-safe
+            # (placements only add matches), so no deferral.
             earlier_any = jnp.cumsum(_f(admit_cap)) - _f(admit_cap)
-            defer = defer | (ra_boot & (earlier_any > 0))
+            live = ra_boot if boot_live is None else (ra_boot & boot_live)
+            defer = defer | (live & (earlier_any > 0))
         return defer
 
     def cond(c):
@@ -354,7 +362,7 @@ def schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
     def body(c):
         unassigned = (c["assigned"] < 0) & batch.valid
         cl = cluster_at(c)
-        feas, aff_unres = feasibility(c, cl)
+        feas, aff_unres, boot_live = feasibility(c, cl)
         feas = feas & unassigned[:, None]
 
         # scores against committed usage + placements so later rounds see
@@ -400,7 +408,7 @@ def schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
         if intra:
             # intra-round topology serialization (conservative; deferred
             # pods re-check against exact committed counts next round)
-            admit = admit & ~topology_deferral(admit, prop)
+            admit = admit & ~topology_deferral(admit, prop, boot_live)
 
         # ---- commit ----
         seg = jnp.where(admit, prop, N)

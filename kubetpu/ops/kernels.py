@@ -352,11 +352,14 @@ def interpod_filter_pre(cluster, batch) -> InterpodPre:
 
 
 def interpod_filter(cluster, batch,
-                    pre: InterpodPre | None = None
-                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                    pre: InterpodPre | None = None,
+                    return_no_matches: bool = False):
     """InterPodAffinity filter.  Returns (ok, affinity_unresolvable) where
     affinity_unresolvable marks required-affinity failures
-    (UnschedulableAndUnresolvable, reference: filtering.go:371-396)."""
+    (UnschedulableAndUnresolvable, reference: filtering.go:371-396).
+    With return_no_matches, also returns the [B] bool marking pods whose
+    required-affinity terms currently match nothing — i.e. the self-match
+    bootstrap branch (filtering.go:356) is what admits them."""
     B = batch.req.shape[0]
     N = cluster.allocatable.shape[0]
     L = cluster.kv.shape[1]
@@ -411,6 +414,8 @@ def interpod_filter(cluster, batch,
                             preferred_element_type=jnp.float32) > 0.5
 
     ok = aff_ok & ~anti_fail & ~exist_fail
+    if return_no_matches:
+        return ok, ~aff_ok, no_matches
     return ok, ~aff_ok
 
 

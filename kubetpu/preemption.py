@@ -72,21 +72,77 @@ class CycleContext:
         self.row_of = row_of or {}   # pod uid -> batch row
         self.feasible = feasible     # [B, N] np.ndarray or None
         self.unresolvable = unresolvable
+        # same-cycle committed placements, overlaid before any what-if: the
+        # reference's reused nodeInfoSnapshot serves exactly ONE pod per
+        # cycle; with B pods per cycle a pod failing late in the batch must
+        # see the capacity already claimed by earlier commits or preemption
+        # overestimates free space and deletes victims for nothing
+        self.commit_req = None       # [N, R] np — committed request channels
+        self.commit_nz = None        # [N, 2] np
+        self.commit_ports = None     # [N, P] np bool — committed host ports
+        self.commits = 0
+        self._verdict_commits = 0
+        self._cluster_cache = None   # (commits, overlaid cluster)
+
+    def note_commit(self, row: int, node_row: int) -> None:
+        """Record a committed batch placement (batch row -> node row)."""
+        if self.batch is None:
+            return
+        if self.commit_req is None:
+            shape = self.cluster.requested.shape
+            self.commit_req = np.zeros(shape, np.float32)
+            self.commit_nz = np.zeros((shape[0], 2), np.float32)
+            self.commit_ports = np.zeros(
+                (shape[0], self.batch.ports_asnode_hot.shape[1]), bool)
+        self.commit_req[node_row] += np.asarray(self.batch.req[row])
+        self.commit_nz[node_row] += np.asarray(self.batch.nonzero_req[row])
+        self.commit_ports[node_row] |= (
+            np.asarray(self.batch.ports_asnode_hot[row]) > 0.5)
+        self.commits += 1
+
+    def cluster_now(self):
+        """The cycle's cluster tensors with committed placements overlaid
+        (resource/pod-count channels and host ports; committed pods'
+        topology terms are not overlaid — a bounded deviation, matching the
+        nominated-pods overlay's scope in the reference,
+        generic_scheduler.go:541-545)."""
+        if self.commits == 0:
+            return self.cluster
+        if (self._cluster_cache is not None
+                and self._cluster_cache[0] == self.commits):
+            return self._cluster_cache[1]
+        import jax.numpy as jnp
+        cl = self.cluster._replace(
+            requested=self.cluster.requested + jnp.asarray(self.commit_req),
+            nonzero_requested=(self.cluster.nonzero_requested
+                               + jnp.asarray(self.commit_nz)),
+            ports=self.cluster.ports | jnp.asarray(self.commit_ports))
+        self._cluster_cache = (self.commits, cl)
+        return cl
 
     def pod_verdicts(self, pod_uid: str):
         """(feasible_row, unresolvable_row) for a cycle pod, computing the
         whole-batch filter pass lazily on first use (one device call shared
-        by every preemption attempt this cycle)."""
+        by every preemption attempt this cycle).  Verdicts taken before the
+        latest commit are STALE — a gang-mode pod that lost purely to
+        intra-batch contention has round-0 feasibility on nodes that are now
+        full, which would exclude exactly the cheapest preemption
+        candidates; returning None routes the caller to its single-pod
+        [1, N] pass against cluster_now(), far cheaper than re-running the
+        whole [B, N] batch per failing pod."""
         row = self.row_of.get(pod_uid)
         if row is None:
+            return None
+        if self.feasible is not None and self._verdict_commits != self.commits:
             return None
         if self.feasible is None:
             if self.batch is None:
                 return None
-            res = programs.filter_and_score(self.cluster, self.batch,
+            res = programs.filter_and_score(self.cluster_now(), self.batch,
                                             self.cfg)
             self.feasible = np.asarray(res.feasible)
             self.unresolvable = np.asarray(res.unresolvable)
+            self._verdict_commits = self.commits
         return self.feasible[row], self.unresolvable[row]
 
 
@@ -265,7 +321,8 @@ class Preemptor:
         verdicts = cycle.pod_verdicts(pod.uid)
         if verdicts is None:
             batch1 = self._pod_batch1(pod, cycle)
-            res = programs.filter_and_score(cycle.cluster, batch1, cycle.cfg)
+            res = programs.filter_and_score(cycle.cluster_now(), batch1,
+                                            cycle.cfg)
             feasible = np.asarray(res.feasible)[0]
             unresolvable = np.asarray(res.unresolvable)[0]
         else:
@@ -366,7 +423,7 @@ class Preemptor:
         if self._batch1 is None:
             self._batch1 = self._pod_batch1(pod, cycle)
         fits0, reprieved = _whatif_reprieve(
-            cycle.cluster, self._batch1, cycle.cfg,
+            cycle.cluster_now(), self._batch1, cycle.cfg,
             jnp.asarray(cand_rows), jnp.asarray(rm_valid),
             jnp.asarray(rm_req), jnp.asarray(rm_nz), jnp.asarray(vic_row),
             jnp.asarray(vic_req), jnp.asarray(vic_nz))

@@ -203,6 +203,7 @@ class SchedulingQueue(PodNominator):
             qp = self.active_q.pop()
             qp.attempts += 1
             self.scheduling_cycle += 1
+            qp.scheduling_cycle = self.scheduling_cycle
             return qp
 
     def pop_batch(self, max_batch: int,
@@ -220,6 +221,7 @@ class SchedulingQueue(PodNominator):
                 qp = self.active_q.pop()
                 qp.attempts += 1
                 self.scheduling_cycle += 1
+                qp.scheduling_cycle = self.scheduling_cycle
                 out.append(qp)
         return out
 

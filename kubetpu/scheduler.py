@@ -333,10 +333,22 @@ class Scheduler:
 
         # ---- device: one program for the whole group (scan or auction)
         if self.config.mode == "gang":
+            from .framework.types import pod_with_affinity
             from .models.gang import schedule_gang
+            # per-round topology re-evaluation only pays off when some pod
+            # actually carries topology terms; a term-free batch takes the
+            # cheaper static path (round-0 verdicts are provably invariant)
+            needs_topo = (any(pod_with_affinity(qp.pod)
+                              or qp.pod.spec.topology_spread_constraints
+                              for qp in live)
+                          # service/RC replicas score via
+                          # DefaultPodTopologySpread even without explicit
+                          # terms — they need intra-batch placements too
+                          or any(s is not None for s in spread_sels))
             res = schedule_gang(
                 cluster, batch, cfg, self._next_rng(),
-                host_ok=self._jax.numpy.asarray(host_ok) if any_host else None)
+                host_ok=self._jax.numpy.asarray(host_ok) if any_host else None,
+                intra_batch_topology=needs_topo)
             # the auction already produced per-pod verdict rows; share them
             # so preemption skips its candidates pass entirely
             cycle_ctx.feasible = np.asarray(res.feasible0)
@@ -364,6 +376,10 @@ class Scheduler:
             node_name = node_infos[int(chosen[i])].node_name
             outcome = self._commit(fwk, qp, state, node_name,
                                    int(n_feas[i]))
+            if outcome.node:
+                # preemption for pods failing later in this batch must see
+                # this placement (CycleContext.cluster_now overlay)
+                cycle_ctx.note_commit(i, int(chosen[i]))
             outcomes.append(outcome)
         trace.step("Committing placements done")
         trace.log_if_long()
@@ -384,11 +400,18 @@ class Scheduler:
         feasible = np.asarray(res.feasible)
         scores = np.asarray(res.scores)
         n_nodes = len(node_infos)
+        row_of_node = {ni.node_name: j for j, ni in enumerate(node_infos)}
         outcomes: List[ScheduleOutcome] = []
         for i, qp in enumerate(live):
             state = states[qp.pod.uid]
             names = [node_infos[j].node_name for j in range(n_nodes)
                      if feasible[i, j]]
+            # the device mask is pre-batch: re-check fit against the LIVE
+            # NodeInfo (includes earlier same-batch assumes) so two pods in
+            # one extender batch cannot oversubscribe a node
+            pod_res = PodInfo(qp.pod).resource
+            names = [n for n in names
+                     if self._fits_live(pod_res, self.cache.node_info(n))]
             dev_score = {node_infos[j].node_name: float(scores[i, j])
                          for j in range(n_nodes) if feasible[i, j]}
             exts = [e for e in self.extenders if e.is_interested(qp.pod)]
@@ -435,9 +458,36 @@ class Scheduler:
             if binders:
                 def binder(pod, node, _b=binders[0]):
                     _b.bind(pod, node)
-            outcomes.append(self._commit(fwk, qp, state, node_name, len(names),
-                                         binder_override=binder))
+            outcome = self._commit(fwk, qp, state, node_name, len(names),
+                                   binder_override=binder)
+            if outcome.node and cycle_ctx is not None:
+                cycle_ctx.note_commit(i, row_of_node[node_name])
+            outcomes.append(outcome)
         return outcomes
+
+    @staticmethod
+    def _fits_live(pod_res, ni) -> bool:
+        """NodeResourcesFit essentials against a live NodeInfo
+        (reference: noderesources/fit.go:194-267): pod count always, the
+        standard channels and scalars only when requested."""
+        if ni is None:
+            return False
+        alloc, req = ni.allocatable, ni.requested
+        if len(ni.pods) + 1 > alloc.allowed_pod_number:
+            return False
+        r = pod_res
+        if r.milli_cpu > 0 and r.milli_cpu > alloc.milli_cpu - req.milli_cpu:
+            return False
+        if r.memory > 0 and r.memory > alloc.memory - req.memory:
+            return False
+        if (r.ephemeral_storage > 0 and r.ephemeral_storage
+                > alloc.ephemeral_storage - req.ephemeral_storage):
+            return False
+        for k, v in r.scalar_resources.items():
+            if v > 0 and v > (alloc.scalar_resources.get(k, 0)
+                              - req.scalar_resources.get(k, 0)):
+                return False
+        return True
 
     # ------------------------------------------------------------------ commit
 
@@ -445,6 +495,24 @@ class Scheduler:
                 node_name: str, n_feasible: int,
                 binder_override=None) -> ScheduleOutcome:
         pod = qp.pod
+        # Commit-time host-filter re-check: the pre-batch host_ok mask was
+        # computed before any same-batch pod was assumed, so two same-batch
+        # pods could exceed a host-checked per-node limit (e.g. attachable
+        # volumes).  Re-validate against the cache's LIVE NodeInfo — which
+        # includes earlier same-batch assumes — before reserving.  The
+        # reference's serial loop gets this by construction
+        # (scheduler.go:509: every pod filters against assumed state).
+        if fwk.has_relevant_host_filters(pod):
+            ni = self.cache.node_info(node_name)
+            if ni is not None:
+                st = fwk.run_filter_plugins(state, pod, ni)
+                if not st.is_success():
+                    # other nodes may still fit next cycle; don't preempt
+                    # on a stale single-node verdict
+                    return self._fail(fwk, qp, state, node_name,
+                                      st.message() or
+                                      "commit-time filter re-check failed",
+                                      preemption_may_help=False)
         # Reserve (reference: scheduler.go:586).  Commit-phase failures are
         # not FitErrors, so they never trigger preemption
         # (reference: scheduler.go:542 err type check).
@@ -562,8 +630,12 @@ class Scheduler:
                         message: str, nominated_node: str = "") -> None:
         pod = qp.pod
         try:
+            # use the cycle captured at pop, not the current counter — pods
+            # popped later in the same batch must not mask a move request
+            # that raced with this pod's scheduling attempt (reference:
+            # scheduler.go:515,559 podSchedulingCycle)
             self.queue.add_unschedulable_if_not_present(
-                qp, self.queue.scheduling_cycle)
+                qp, qp.scheduling_cycle)
         except ValueError:
             pass
         if self.recorder:

@@ -110,6 +110,14 @@ class SchedulerCache:
             self.nodes[name] = item
         return item
 
+    def node_info(self, name: str) -> Optional[NodeInfo]:
+        """A CLONE of the live NodeInfo for a node — includes assumed pods,
+        unlike the cycle snapshot (reference: cache.go GetNodeInfo).  Cloned
+        under the lock so callers never race informer-thread mutations."""
+        with self._lock:
+            item = self.nodes.get(name)
+            return item.info.clone() if item is not None else None
+
     # -- pods ---------------------------------------------------------------
 
     def assume_pod(self, pod: api.Pod) -> None:

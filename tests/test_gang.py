@@ -276,3 +276,28 @@ def test_unresolvable_diag_matches_filter_pass():
     g = gang.schedule_gang(cluster, batch, cfg, jax.random.PRNGKey(0))
     assert np.asarray(g.chosen)[0] == 1
     assert bool(np.asarray(g.unresolvable)[0, 0])
+
+
+def test_self_affinity_gang_converges_in_few_rounds():
+    # A "co-locate all replicas" gang: every pod requires zone affinity to
+    # its own app label.  Round 1 admits the bootstrap pod (self-match,
+    # filtering.go:356) and every later pod sees real matches, so the
+    # deferral must NOT serialize to one admission per round — the batch
+    # converges in O(1) rounds, not O(B).
+    from kubetpu.harness import hollow
+    B = 12
+    nodes = [mknode(name=f"n{i}", labels={
+        api.LABEL_HOSTNAME: f"n{i}", api.LABEL_ZONE: f"z{i % 2}"})
+        for i in range(4)]
+    pending = [hollow.with_affinity(
+        mkpod(name=f"p{i}", labels={"app": "gang"}), api.LABEL_ZONE)
+        for i in range(B)]
+    cluster, batch, cfg, _ = build(nodes, {}, pending, filters=TOPO_FILTERS)
+    g = gang.schedule_gang(cluster, batch, cfg, jax.random.PRNGKey(0))
+    chosen = np.asarray(g.chosen)[:B]
+    assert (chosen >= 0).all()
+    # all replicas share one zone (affinity satisfied against the batch)
+    zones = {int(c) % 2 for c in chosen}
+    assert len(zones) == 1, chosen
+    # bootstrap defers only round 1; everything else co-admits
+    assert int(g.rounds) <= 4, int(g.rounds)
