@@ -111,25 +111,35 @@ class NominatedPods(NamedTuple):
     node: np.ndarray   # [M] i32 node row
     prio: np.ndarray   # [M] i32 pod priority
     valid: np.ndarray  # [M] bool
+    self_row: np.ndarray  # [M] i32 — the nominated pod's own row in the
+                       # CURRENT batch (-1 if not in it); a pod never
+                       # overlays itself (addNominatedPods skips the pod
+                       # being scheduled)
 
 
 def build_nominated(entries: Sequence, table: InternTable,
                     pad_m: Optional[int] = None) -> NominatedPods:
-    """entries: (PodInfo, node_row) pairs for pods nominated to snapshot
-    rows.  Returns the device overlay arrays (pow2-padded)."""
+    """entries: (PodInfo, node_row) or (PodInfo, node_row, self_row) tuples
+    for pods nominated to snapshot rows.  Returns the device overlay arrays
+    (pow2-padded)."""
     R = N_FIXED_CHANNELS + table.rname.cap
     M = pad_m if pad_m is not None else pow2_bucket(len(entries), 1)
     req = np.zeros((M, R), np.float32)
     node = np.full((M,), -1, np.int32)
     prio = np.zeros((M,), np.int32)
     valid = np.zeros((M,), bool)
-    for i, (pi, row) in enumerate(entries):
+    self_row = np.full((M,), -1, np.int32)
+    for i, entry in enumerate(entries):
+        pi, row = entry[0], entry[1]
         req[i] = resource_to_channels(pi.resource, table, R, intern_new=False)
         req[i, CH_PODS] = 1.0
         node[i] = row
         prio[i] = pi.pod.priority()
         valid[i] = True
-    return NominatedPods(req=req, node=node, prio=prio, valid=valid)
+        if len(entry) > 2:
+            self_row[i] = entry[2]
+    return NominatedPods(req=req, node=node, prio=prio, valid=valid,
+                         self_row=self_row)
 
 
 def densify_for(cluster, batch: "PodBatch") -> "PodBatch":

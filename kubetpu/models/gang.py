@@ -153,23 +153,6 @@ def _key_terms_mask(terms, k: int) -> jnp.ndarray:
     return (terms.topo_key == k) & terms.valid & terms.topo_known
 
 
-def _fit_rows(req: jnp.ndarray, avail: jnp.ndarray) -> jnp.ndarray:
-    """Per-row NodeResourcesFit verdict for request rows [B, R] against
-    available rows [B, R] (fit.go:194-267 semantics: pod count always
-    checked; cpu/mem/ephemeral checked when the pod requests anything;
-    scalar channels only when requested)."""
-    free_ok = avail >= req
-    R = req.shape[1]
-    ch = jnp.arange(R)
-    is_fixed = (ch < K.N_FIXED_CHANNELS) & (ch != K.CH_PODS)
-    check = jnp.where(is_fixed[None, :], True, req > 0)
-    res_ok = jnp.all(free_ok | ~check | (ch == K.CH_PODS)[None, :], axis=-1)
-    pods_ok = free_ok[:, K.CH_PODS]
-    nonpods = jnp.where((ch == K.CH_PODS)[None, :], 0.0, req)
-    zero_req = jnp.all(nonpods == 0, axis=-1)
-    return pods_ok & (zero_req | res_ok)
-
-
 @functools.partial(jax.jit,
                    static_argnames=("cfg", "max_rounds",
                                     "intra_batch_topology"))
@@ -392,7 +375,7 @@ def schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
         node_safe = jnp.clip(snode, 0, N - 1)                   # proposers'
         free = (cluster.allocatable[node_safe]                  # usage
                 - c["req"][node_safe])
-        cap_ok = _fit_rows(batch.req[order], free - prefix_excl)
+        cap_ok = K.fit_rows(batch.req[order], free - prefix_excl)
 
         if use_ports:
             sreg = batch.ports_asnode_hot[order] * _f(sactive)[:, None]

@@ -60,6 +60,12 @@ class ProgramConfig(NamedTuple):
     # per-plugin static kernel args, e.g. RequestedToCapacityRatio's shape
     # or NodeLabel's resolved key ids: ((plugin, args-tuple), ...)
     plugin_args: Tuple[Tuple[str, Tuple], ...] = ()
+    # adaptive node-sampling percentage for the sequential replay
+    # (reference: percentageOfNodesToScore, generic_scheduler.go:54-59,
+    # 379-399).  100 = search every node (the unit-test/kernel default);
+    # 0 = the reference's adaptive default 50 - n/125, floor 5%; the
+    # sampled search only ever binds on clusters >= 100 nodes.
+    percentage_of_nodes_to_score: int = 100
 
     def arg(self, name: str, default=()):
         for n, a in self.plugin_args:
@@ -229,6 +235,46 @@ def filter_and_score(cluster, batch, cfg: ProgramConfig,
     scores, per_plugin = run_scores(cluster, batch, cfg, feasible, affinity_ok)
     return FilterScoreResult(feasible=feasible, unresolvable=unresolvable,
                              scores=scores, plugin_scores=per_plugin)
+
+
+@jax.jit
+def nominated_fit_mask(cluster, batch, nom):
+    """The nominated-pods overlay pass (reference: addNominatedPods +
+    two-pass filtering, core/generic_scheduler.go:530,594-612): for each
+    pod, nominated pods of EQUAL-OR-GREATER priority — excluding the pod
+    ITSELF when it is the nominator — are treated as already running on
+    their nominated nodes, and the pod must fit with that usage added.  The
+    second (overlay-free) pass of the reference is the main filter program,
+    so ANDing this mask in reproduces the two-pass rule for the resource
+    dimension (topology-term contributions of nominated pods are not
+    overlaid — a documented deviation, see models/batch.py NominatedPods).
+
+    The mask differs from all-True only at the <=M nominated node rows, so
+    the work is [B, M, R] (M = nominated pods, tiny) — never [B, N, R].
+    Returns [B, N] bool."""
+    from .batch import densify_for
+    from ..ops import kernels as K
+    batch = densify_for(cluster, batch)
+    B = batch.priority.shape[0]
+    N = cluster.allocatable.shape[0]
+    M = nom.node.shape[0]
+    ok_entry = nom.valid & (nom.node >= 0)
+    # w[b, j]: entry j reserves capacity against pod b
+    w = (nom.prio[None, :] >= batch.priority[:, None]) & ok_entry[None, :] \
+        & (nom.self_row[None, :] != jnp.arange(B)[:, None])
+    # same_node[m, j]: entry j lands on slot m's node (duplicates collapse:
+    # every slot on a node carries that node's FULL applicable sum)
+    same_node = (nom.node[None, :] == nom.node[:, None]) & ok_entry[None, :]
+    overlay = jnp.einsum("bj,mj,jr->bmr", w.astype(jnp.float32),
+                         same_node.astype(jnp.float32), nom.req,
+                         preferred_element_type=jnp.float32)  # [B, M, R]
+    rows = jnp.clip(nom.node, 0, N - 1)
+    free = cluster.allocatable[rows] - cluster.requested[rows]  # [M, R]
+    ok = K.fit_rows(jnp.broadcast_to(batch.req[:, None, :], overlay.shape),
+                    free[None, :, :] - overlay)                 # [B, M]
+    mask = jnp.ones((B, N), bool).at[:, rows].min(
+        jnp.where(ok_entry[None, :], ok, True))
+    return mask
 
 
 def select_host(scores: jnp.ndarray, feasible: jnp.ndarray,

@@ -39,10 +39,24 @@ class SeqResult(NamedTuple):
     chosen: jnp.ndarray        # [B] i32 node row, -1 unschedulable
     score: jnp.ndarray         # [B] f32 winning score
     n_feasible: jnp.ndarray    # [B] i32 feasible-node count at the pod's turn
+                               # (of the SAMPLED search when sampling binds)
     all_unresolvable: jnp.ndarray  # [B] bool — every failed node failed
                                # UnschedulableAndUnresolvable (preemption
                                # cannot help; scheduler.go:391 preempt gate)
     requested: jnp.ndarray     # [N, R] final requested (for host cache sync checks)
+    next_start: jnp.ndarray    # i32 — rotated start index after the batch
+                               # (reference: nextStartNodeIndex,
+                               # generic_scheduler.go:451,487)
+
+
+def _num_feasible_nodes_to_find(n_valid, pct: int):
+    """reference: generic_scheduler.go:54-59,379-399 numFeasibleNodesToFind.
+    n_valid is traced (i32); pct is static."""
+    if pct >= 100:
+        return n_valid
+    adaptive = pct if pct > 0 else jnp.maximum(50 - n_valid // 125, 5)
+    num = jnp.maximum(n_valid * adaptive // 100, 100)
+    return jnp.where(n_valid < 100, n_valid, num)
 
 
 def _term_state(cluster, terms, B):
@@ -70,7 +84,7 @@ def _batch_term_matches(terms, batch, B):
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=())
 def schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
                         hard_pod_affinity_weight: float = 1.0,
-                        host_ok=None) -> SeqResult:
+                        host_ok=None, start_index=0) -> SeqResult:
     from .batch import densify_for
     batch = densify_for(cluster, batch)
     B = batch.req.shape[0]
@@ -78,6 +92,13 @@ def schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
     L = cluster.kv.shape[1]
     filters = set(cfg.filters)
     score_w = dict(cfg.scores)
+    # adaptive sampling: each pod searches only the first `limit` feasible
+    # nodes in rotated processing order, then advances the start index by
+    # the number of nodes examined (generic_scheduler.go:379-399,451,487)
+    sample = cfg.percentage_of_nodes_to_score < 100
+    n_valid = jnp.sum(cluster.node_valid.astype(jnp.int32))
+    sample_limit = _num_feasible_nodes_to_find(
+        n_valid, cfg.percentage_of_nodes_to_score)
 
     # ---------------- static precompute (batched, MXU-heavy) ----------------
     base = cluster.node_valid[None, :] & batch.valid[:, None]
@@ -264,6 +285,8 @@ def schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
         "req": cluster.requested,
         "nz": cluster.nonzero_requested,
     }
+    if sample:
+        carry0["start"] = jnp.asarray(start_index, jnp.int32)
     if ports_ok0 is not None:
         # ports the scan's own placements have registered per node; existing
         # pods' ports are already inside ports_ok0 via cluster.ports
@@ -343,6 +366,27 @@ def schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
             exist_fail = (carry["ea_cnt"][i] @ kv_f.T) > 0.5
             unres = unres | (~aff_ok & static_ok[i])
             feas = feas & aff_ok & ~anti_fail & ~exist_fail
+
+        # ---- adaptive sampling: keep only the first `sample_limit`
+        # feasible nodes in rotated processing order (reference:
+        # findNodesThatFit's stop-at-numFeasibleNodesToFind + the
+        # nextStartNodeIndex rotation, generic_scheduler.go:451-487)
+        if sample:
+            start = carry["start"]
+            k = jnp.arange(N)
+            in_range = k < n_valid
+            nv = jnp.maximum(n_valid, 1)
+            perm = jnp.where(in_range, (start + k) % nv, 0)
+            feas_perm = jnp.where(in_range, feas[perm], False)
+            cum = jnp.cumsum(feas_perm.astype(jnp.int32))
+            allowed_perm = feas_perm & (cum <= sample_limit)
+            total_feas = cum[-1]
+            reached = cum >= sample_limit
+            kth_pos = jnp.argmax(reached)
+            n_processed = jnp.where(total_feas >= sample_limit,
+                                    kth_pos + 1, n_valid)
+            feas = jnp.zeros((N,), bool).at[perm].max(allowed_perm)
+            new_start = (start + n_processed) % nv
 
         # ---- scores
         total = jnp.zeros((N,), jnp.float32)
@@ -499,6 +543,9 @@ def schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
         new = dict(carry)
         new["req"] = carry["req"].at[node].add(batch.req[i] * w)
         new["nz"] = carry["nz"].at[node].add(batch.nonzero_req[i] * w)
+        if sample:
+            # padded (invalid) pods must not advance the rotation
+            new["start"] = jnp.where(batch.valid[i], new_start, carry["start"])
         if ports_ok0 is not None:
             new["ports_used"] = carry["ports_used"].at[node].max(
                 batch.ports_asnode_hot[i] * w)
@@ -553,5 +600,8 @@ def schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
 
     carry, (chosen, score, n_feas, all_unres) = jax.lax.scan(
         step, carry0, jnp.arange(B))
+    next_start = carry["start"] if sample else jnp.asarray(start_index,
+                                                           jnp.int32)
     return SeqResult(chosen=chosen, score=score, n_feasible=n_feas,
-                     all_unresolvable=all_unres, requested=carry["req"])
+                     all_unresolvable=all_unres, requested=carry["req"],
+                     next_start=next_start)

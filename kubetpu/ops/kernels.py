@@ -87,6 +87,23 @@ def pod_topo_pairs(cluster, topo_key_s: jnp.ndarray) -> jnp.ndarray:
 # filters — each returns ok [B, N] bool (over valid nodes; caller masks padding)
 
 
+def fit_rows(req: jnp.ndarray, avail: jnp.ndarray) -> jnp.ndarray:
+    """Row-wise NodeResourcesFit verdict: request rows [X, R] against
+    available rows [X, R] (fit.go:194-267 semantics: pod count always
+    checked; cpu/mem/ephemeral checked when the pod requests anything;
+    scalar channels only when requested)."""
+    free_ok = avail >= req
+    R = req.shape[-1]
+    ch = jnp.arange(R)
+    is_fixed = (ch < N_FIXED_CHANNELS) & (ch != CH_PODS)
+    check = jnp.where(is_fixed, True, req > 0)
+    res_ok = jnp.all(free_ok | ~check | (ch == CH_PODS), axis=-1)
+    pods_ok = free_ok[..., CH_PODS]
+    nonpods = jnp.where(ch == CH_PODS, 0.0, req)
+    zero_req = jnp.all(nonpods == 0, axis=-1)
+    return pods_ok & (zero_req | res_ok)
+
+
 def fit_filter(cluster, batch, ignored_channels: jnp.ndarray | None = None) -> jnp.ndarray:
     """NodeResourcesFit (reference: noderesources/fit.go:194-267 fitsRequest).
     ignored_channels: optional [R] f32 mask, 1.0 = check the channel."""

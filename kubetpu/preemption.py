@@ -309,6 +309,33 @@ class Preemptor:
         return jax.tree.map(np.asarray,
                             pb.build([PodInfo(pod)], spread_selectors=[sel]))
 
+    def _cluster_with_nominated(self, pod: api.Pod, cycle: CycleContext):
+        """cluster_now plus equal/higher-priority nominated pods' resources
+        on their nominated rows — the preemption simulation must respect
+        capacity other preemptors already reserved (reference:
+        addNominatedPods inside fitsOnNode, generic_scheduler.go:594)."""
+        import jax.numpy as jnp
+        from .models.batch import build_nominated
+        cl = cycle.cluster_now()
+        prio = pod.priority()
+        node_row = {ni.node_name: j
+                    for j, ni in enumerate(cycle.node_infos)}
+        entries = []
+        for p, nn in self.sched.queue.all_nominated():
+            if p.uid == pod.uid or p.priority() < prio:
+                continue
+            row = node_row.get(nn)
+            if row is None:
+                continue
+            entries.append((PodInfo(p), row))
+        if not entries:
+            return cl
+        nom = build_nominated(entries, cycle.builder.table)
+        add = np.zeros(cl.requested.shape, np.float32)
+        keep = nom.valid & (nom.node >= 0)
+        np.add.at(add, nom.node[keep], nom.req[keep])
+        return cl._replace(requested=cl.requested + jnp.asarray(add))
+
     # ------------------------------------------------------- candidate nodes
 
     def _nodes_where_preemption_might_help(self, fwk, pod: api.Pod,
@@ -423,7 +450,7 @@ class Preemptor:
         if self._batch1 is None:
             self._batch1 = self._pod_batch1(pod, cycle)
         fits0, reprieved = _whatif_reprieve(
-            cycle.cluster_now(), self._batch1, cycle.cfg,
+            self._cluster_with_nominated(pod, cycle), self._batch1, cycle.cfg,
             jnp.asarray(cand_rows), jnp.asarray(rm_valid),
             jnp.asarray(rm_req), jnp.asarray(rm_nz), jnp.asarray(vic_row),
             jnp.asarray(vic_req), jnp.asarray(vic_nz))

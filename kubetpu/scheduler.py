@@ -91,6 +91,9 @@ class Scheduler:
             metrics=metrics)
         self.snapshot = Snapshot()
         self._rng_counter = seed
+        # rotating node-search start (reference: nextStartNodeIndex,
+        # generic_scheduler.go:451); persists across cycles
+        self._next_start_node_index = 0
         self._jax = jax
         self._async_binding = async_binding
         self._bind_pool = ThreadPoolExecutor(max_workers=16,
@@ -315,10 +318,23 @@ class Scheduler:
             for j, ni in enumerate(node_infos):
                 st = fwk.run_filter_plugins(state, qp.pod, ni)
                 host_ok[i, j] = st.is_success()
+        # ---- nominated-pods two-pass overlay (addNominatedPods,
+        # generic_scheduler.go:530,594-612): equal/higher-priority pods
+        # nominated by preemption reserve their nominated nodes' capacity
+        nom_mask = self._nominated_overlay_mask(builder, cluster, batch,
+                                                live, node_infos)
+        if nom_mask is not None:
+            host_ok &= nom_mask
+            any_host = True
         cfg = programs.ProgramConfig(
             filters=fwk.tensor_filters, scores=fwk.tensor_scores,
             hostname_topokey=max(builder.table.topokey.get(api.LABEL_HOSTNAME), 0),
-            plugin_args=fwk.tensor_plugin_args(builder.table))
+            plugin_args=fwk.tensor_plugin_args(builder.table),
+            # 0 => the reference's adaptive default (types.go:251); only
+            # the sequential replay consumes it — gang needs the global view
+            percentage_of_nodes_to_score=(
+                self.config.percentage_of_nodes_to_score
+                if self.config.percentage_of_nodes_to_score > 0 else 0))
         from .preemption import CycleContext
         cycle_ctx = CycleContext(
             builder=builder, cluster=cluster, cfg=cfg,
@@ -357,7 +373,9 @@ class Scheduler:
             res = schedule_sequential(
                 cluster, batch, cfg, self._next_rng(),
                 hard_pod_affinity_weight=float(fwk.hard_pod_affinity_weight),
-                host_ok=self._jax.numpy.asarray(host_ok) if any_host else None)
+                host_ok=self._jax.numpy.asarray(host_ok) if any_host else None,
+                start_index=self._next_start_node_index % max(n_nodes, 1))
+            self._next_start_node_index = int(res.next_start)
         chosen = np.asarray(res.chosen)[:len(live)]
         n_feas = np.asarray(res.n_feasible)[:len(live)]
         unres = np.asarray(res.all_unresolvable)[:len(live)]
@@ -407,11 +425,11 @@ class Scheduler:
             names = [node_infos[j].node_name for j in range(n_nodes)
                      if feasible[i, j]]
             # the device mask is pre-batch: re-check fit against the LIVE
-            # NodeInfo (includes earlier same-batch assumes) so two pods in
-            # one extender batch cannot oversubscribe a node
+            # node usage (includes earlier same-batch assumes) so two pods
+            # in one extender batch cannot oversubscribe a node
             pod_res = PodInfo(qp.pod).resource
             names = [n for n in names
-                     if self._fits_live(pod_res, self.cache.node_info(n))]
+                     if self._fits_live(pod_res, self.cache.node_fit_view(n))]
             dev_score = {node_infos[j].node_name: float(scores[i, j])
                          for j in range(n_nodes) if feasible[i, j]}
             exts = [e for e in self.extenders if e.is_interested(qp.pod)]
@@ -465,15 +483,40 @@ class Scheduler:
             outcomes.append(outcome)
         return outcomes
 
+    def _nominated_overlay_mask(self, builder, cluster, batch, live,
+                                node_infos):
+        """[B, N] bool — False where a pod would not fit once
+        equal-or-greater-priority NOMINATED pods are counted as running on
+        their nominated nodes (reference: addNominatedPods,
+        core/generic_scheduler.go:530; the overlay-free second pass is the
+        main filter program).  A nominated pod that is itself in the batch
+        reserves capacity against every OTHER row, never its own.  None
+        when no nominated pod is relevant."""
+        from .models.batch import build_nominated
+        uid_to_row = {qp.pod.uid: i for i, qp in enumerate(live)}
+        node_row = {ni.node_name: j for j, ni in enumerate(node_infos)}
+        entries = []
+        for pod, nn in self.queue.all_nominated():
+            row = node_row.get(nn)
+            if row is None:
+                continue
+            entries.append((PodInfo(pod), row, uid_to_row.get(pod.uid, -1)))
+        if not entries:
+            return None
+        nom = build_nominated(entries, builder.table)
+        mask = programs.nominated_fit_mask(cluster, batch, nom)
+        return np.asarray(mask)
+
     @staticmethod
-    def _fits_live(pod_res, ni) -> bool:
-        """NodeResourcesFit essentials against a live NodeInfo
-        (reference: noderesources/fit.go:194-267): pod count always, the
+    def _fits_live(pod_res, view) -> bool:
+        """NodeResourcesFit essentials against a live fit view
+        (cache.node_fit_view: allocatable, requested, pod count;
+        reference: noderesources/fit.go:194-267): pod count always, the
         standard channels and scalars only when requested."""
-        if ni is None:
+        if view is None:
             return False
-        alloc, req = ni.allocatable, ni.requested
-        if len(ni.pods) + 1 > alloc.allowed_pod_number:
+        alloc, req, n_pods = view
+        if n_pods + 1 > alloc.allowed_pod_number:
             return False
         r = pod_res
         if r.milli_cpu > 0 and r.milli_cpu > alloc.milli_cpu - req.milli_cpu:
@@ -629,6 +672,12 @@ class Scheduler:
     def _record_failure(self, fwk: Framework, qp: QueuedPodInfo,
                         message: str, nominated_node: str = "") -> None:
         pod = qp.pod
+        if nominated_node:
+            # requeueing re-registers the pod with the nominator from
+            # pod.status (queue._add fallback); carry the fresh nomination
+            # so it survives (reference: scheduler.go:352 — the API update
+            # and queue re-add both see NominatedNodeName)
+            pod.status.nominated_node_name = nominated_node
         try:
             # use the cycle captured at pop, not the current counter — pods
             # popped later in the same batch must not mask a move request

@@ -141,3 +141,68 @@ def test_pick_one_node_lexicographic():
     # then latest start time of top victim
     assert pick_one_node_for_preemption(
         {"a": mk([40], ts=100.0), "b": mk([40], ts=200.0)}) == "b"
+
+
+def test_nominated_node_not_stolen_by_lower_priority():
+    """Preemptor-starvation regression (reference: addNominatedPods,
+    generic_scheduler.go:530,594-612): after a preemption nominates a pod
+    to a node, a lower-priority pod scheduled in a later cycle must NOT
+    take the freed capacity — it is reserved for the nominator."""
+    store = ClusterStore()
+    store.add(hollow.make_node("n1", cpu_milli=2000))
+    sched = Scheduler(store, async_binding=False)
+    fill_node(store, "n1", n=1, prio=0, cpu=2000)
+
+    high = hollow.make_pod("high", cpu_milli=2000, priority=100)
+    store.add(high)
+    first = sched.schedule_pending(timeout=0.0)
+    assert first[0].err is not None
+    assert store.get_pod("default", "high").status.nominated_node_name == "n1"
+    # victim deleted; the node is now "free" — but reserved by nomination
+    sneak = hollow.make_pod("sneak", cpu_milli=2000, priority=0)
+    store.add(sneak)
+    out = sched.schedule_pending(timeout=0.0)
+    names = {o.pod.metadata.name: o for o in out}
+    assert "sneak" in names and names["sneak"].err is not None
+    assert store.get_pod("default", "sneak").spec.node_name == ""
+    # the nominator itself still lands there on retry
+    outcomes = retry(sched)
+    assert store.get_pod("default", "high").spec.node_name == "n1"
+
+
+def test_higher_priority_ignores_lower_nominations():
+    """The overlay applies only to equal-or-greater priority nominated
+    pods: a HIGHER-priority pod may take the node over a lower-priority
+    nomination (reference: priority check in addNominatedPods)."""
+    store = ClusterStore()
+    store.add(hollow.make_node("n1", cpu_milli=2000))
+    sched = Scheduler(store, async_binding=False)
+    fill_node(store, "n1", n=1, prio=0, cpu=2000)
+
+    mid = hollow.make_pod("mid", cpu_milli=2000, priority=50)
+    store.add(mid)
+    first = sched.schedule_pending(timeout=0.0)
+    assert store.get_pod("default", "mid").status.nominated_node_name == "n1"
+    boss = hollow.make_pod("boss", cpu_milli=2000, priority=100)
+    store.add(boss)
+    out = retry(sched)
+    # the higher-priority pod wins the freed node
+    assert store.get_pod("default", "boss").spec.node_name == "n1"
+
+
+def test_own_nomination_does_not_block_self_in_batch():
+    """A nominated pod scheduled in the same batch as a lower-priority pod:
+    the nomination must block the OTHER pod's row, never the nominator's
+    own (addNominatedPods skips the pod being scheduled)."""
+    store = ClusterStore()
+    store.add(hollow.make_node("n1", cpu_milli=2000))
+    sched = Scheduler(store, async_binding=False)
+    fill_node(store, "n1", n=1, prio=0, cpu=2000)
+    high = hollow.make_pod("high", cpu_milli=2000, priority=100)
+    store.add(high)
+    sched.schedule_pending(timeout=0.0)   # preempts, nominates n1
+    assert store.get_pod("default", "high").status.nominated_node_name == "n1"
+    store.add(hollow.make_pod("sneak", cpu_milli=2000, priority=0))
+    out = retry(sched)                    # high + sneak pop together
+    assert store.get_pod("default", "high").spec.node_name == "n1"
+    assert store.get_pod("default", "sneak").spec.node_name == ""

@@ -208,3 +208,56 @@ class TestDifferentialVsSerial:
         got = [names[c] if c >= 0 else None
                for c in np.asarray(res.chosen)[:len(pods)]]
         assert got == want
+
+
+class TestAdaptiveSampling:
+    """numFeasibleNodesToFind + nextStartNodeIndex rotation (reference:
+    core/generic_scheduler.go:54-59,379-399,451,487)."""
+
+    def _run(self, n_nodes, n_pods, pct, start=0, seed=0):
+        nodes = [mknode(name=f"n{i:04d}", cpu="64") for i in range(n_nodes)]
+        infos = [NodeInfo(n) for n in nodes]
+        sb = SnapshotBuilder()
+        pending = [mkpod(name=f"p{i}", cpu="100m") for i in range(n_pods)]
+        pinfos = [PodInfo(p) for p in pending]
+        sb.intern_pending(pinfos)
+        cluster = sb.build(infos).to_device()
+        batch = jax.tree.map(np.asarray, PodBatchBuilder(sb.table).build(pinfos))
+        cfg = programs.ProgramConfig(
+            filters=("NodeResourcesFit",),
+            scores=(),
+            percentage_of_nodes_to_score=pct)
+        return sequential.schedule_sequential(
+            cluster, batch, cfg, jax.random.PRNGKey(seed), start_index=start)
+
+    def test_adaptive_default_1000_nodes(self):
+        # 1000 nodes, pct unset (0 => adaptive): 50 - 1000/125 = 42% =>
+        # 420 nodes searched per pod, all feasible here
+        res = self._run(1000, 3, pct=0)
+        n_feas = np.asarray(res.n_feasible)[:3]
+        assert (n_feas == 420).all(), n_feas
+        chosen = np.asarray(res.chosen)[:3]
+        # rotation: pod 0 searches rows [0,420), pod 1 [420,840),
+        # pod 2 [840,1000)+[0,260)
+        assert 0 <= chosen[0] < 420
+        assert 420 <= chosen[1] < 840
+        assert chosen[2] >= 840 or chosen[2] < 260
+        assert int(res.next_start) == (3 * 420) % 1000
+
+    def test_min_100_floor(self):
+        # 120 nodes: adaptive = 50 - 0 = 49% -> 58 < 100 -> floor 100
+        res = self._run(120, 1, pct=0)
+        assert int(np.asarray(res.n_feasible)[0]) == 100
+
+    def test_small_cluster_searches_all(self):
+        res = self._run(50, 1, pct=0)
+        assert int(np.asarray(res.n_feasible)[0]) == 50
+
+    def test_pct_100_disables_sampling(self):
+        res = self._run(1000, 1, pct=100)
+        assert int(np.asarray(res.n_feasible)[0]) == 1000
+
+    def test_explicit_percentage(self):
+        # pct=30 at 1000 nodes -> 300
+        res = self._run(1000, 1, pct=30)
+        assert int(np.asarray(res.n_feasible)[0]) == 300
