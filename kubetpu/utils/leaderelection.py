@@ -33,6 +33,21 @@ class LeaseRecord:
     lease_duration: float = DEFAULT_LEASE_DURATION
 
 
+def _acquire_or_renew(rec: LeaseRecord, identity: str, lease_duration: float,
+                      now: float) -> bool:
+    """The lease decision shared by every lock backend (reference:
+    leaderelection.go:326 tryAcquireOrRenew).  Mutates rec on success."""
+    expired = now > rec.renew_time + rec.lease_duration
+    if rec.holder and rec.holder != identity and not expired:
+        return False
+    if rec.holder != identity:
+        rec.holder = identity
+        rec.acquire_time = now
+    rec.renew_time = now
+    rec.lease_duration = lease_duration
+    return True
+
+
 class InMemoryLock:
     """Shared lock object (the coordination/v1 Lease analog)."""
 
@@ -47,21 +62,75 @@ class InMemoryLock:
     def try_acquire_or_renew(self, identity: str, lease_duration: float,
                              now: float) -> bool:
         with self._mu:
-            rec = self._rec
-            expired = now > rec.renew_time + rec.lease_duration
-            if rec.holder and rec.holder != identity and not expired:
-                return False
-            if rec.holder != identity:
-                rec.holder = identity
-                rec.acquire_time = now
-            rec.renew_time = now
-            rec.lease_duration = lease_duration
-            return True
+            return _acquire_or_renew(self._rec, identity, lease_duration, now)
 
     def release(self, identity: str) -> None:
         with self._mu:
             if self._rec.holder == identity:
                 self._rec = LeaseRecord()
+
+
+class FileLock:
+    """Lease record persisted as a JSON file — the cross-PROCESS lock
+    backend for `python -m kubetpu` (the coordination/v1 Lease analog for
+    standalone runs; reference resourcelock interface:
+    client-go/tools/leaderelection/resourcelock/interface.go).  The whole
+    read-modify-write runs under an fcntl.flock on a sidecar .lock file, so
+    contending PROCESSES serialize exactly like the reference's CAS against
+    the apiserver's resourceVersion; record writes are atomic (tmp+rename)
+    so readers never see a torn file."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._mu = threading.Lock()
+
+    def _read(self) -> LeaseRecord:
+        import json
+        import os
+        if not os.path.exists(self.path):
+            return LeaseRecord()
+        try:
+            with open(self.path) as f:
+                return LeaseRecord(**json.load(f))
+        except Exception:
+            return LeaseRecord()
+
+    def _write(self, rec: LeaseRecord) -> None:
+        import json
+        import os
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(vars(rec), f)
+        os.replace(tmp, self.path)
+
+    def _flocked(self, fn):
+        import fcntl
+        with self._mu:
+            with open(f"{self.path}.lock", "w") as lf:
+                fcntl.flock(lf, fcntl.LOCK_EX)
+                try:
+                    return fn()
+                finally:
+                    fcntl.flock(lf, fcntl.LOCK_UN)
+
+    def get(self) -> LeaseRecord:
+        return self._flocked(self._read)
+
+    def try_acquire_or_renew(self, identity: str, lease_duration: float,
+                             now: float) -> bool:
+        def attempt():
+            rec = self._read()
+            if not _acquire_or_renew(rec, identity, lease_duration, now):
+                return False
+            self._write(rec)
+            return True
+        return self._flocked(attempt)
+
+    def release(self, identity: str) -> None:
+        def rel():
+            if self._read().holder == identity:
+                self._write(LeaseRecord())
+        self._flocked(rel)
 
 
 class LeaderElector:
