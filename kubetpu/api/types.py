@@ -248,6 +248,8 @@ class Volume:
     persistent_volume_claim: Optional[str] = None  # claim name
     gce_persistent_disk: Optional[str] = None      # pd name
     aws_elastic_block_store: Optional[str] = None  # volume id
+    azure_disk: Optional[str] = None               # disk name
+    cinder: Optional[str] = None                   # volume id
     iscsi: Optional[Tuple[str, int, str]] = None   # (target portal, lun, iqn)
     rbd: Optional[Tuple[str, str, str]] = None     # (monitors-key, pool, image)
     read_only: bool = False
@@ -411,6 +413,8 @@ class PersistentVolume:
     # volume source (scheduler-relevant subset, for NodeVolumeLimits)
     aws_elastic_block_store: Optional[str] = None   # volume id
     gce_persistent_disk: Optional[str] = None       # pd name
+    azure_disk: Optional[str] = None                # disk name
+    cinder: Optional[str] = None                    # volume id
     csi_driver: Optional[str] = None                # driver name
     csi_volume_handle: Optional[str] = None
     kind: str = "PersistentVolume"

@@ -15,8 +15,9 @@ DEFAULT_PERCENTAGE_OF_NODES_TO_SCORE = 0  # 0 => adaptive (types.go:251)
 DEFAULT_SCHEDULER_NAME = "default-scheduler"
 
 EXTENSION_POINTS = (
-    "queue_sort", "pre_filter", "filter", "pre_score", "score",
-    "reserve", "permit", "pre_bind", "bind", "post_bind", "unreserve",
+    "queue_sort", "pre_filter", "filter", "post_filter", "pre_score",
+    "score", "reserve", "permit", "pre_bind", "bind", "post_bind",
+    "unreserve",
 )
 
 
@@ -40,6 +41,7 @@ class Plugins:
     queue_sort: PluginSet = field(default_factory=PluginSet)
     pre_filter: PluginSet = field(default_factory=PluginSet)
     filter: PluginSet = field(default_factory=PluginSet)
+    post_filter: PluginSet = field(default_factory=PluginSet)
     pre_score: PluginSet = field(default_factory=PluginSet)
     score: PluginSet = field(default_factory=PluginSet)
     reserve: PluginSet = field(default_factory=PluginSet)

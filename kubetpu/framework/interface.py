@@ -177,6 +177,27 @@ class FilterPlugin(Plugin):
         raise NotImplementedError
 
 
+class PostFilterResult:
+    """reference: framework/v1alpha1/interface.go:522."""
+    __slots__ = ("nominated_node_name",)
+
+    def __init__(self, nominated_node_name: str = ""):
+        self.nominated_node_name = nominated_node_name
+
+
+class PostFilterPlugin(Plugin):
+    """Called when no node passed filtering; may make the pod schedulable
+    (e.g. by preempting).  Statuses: SUCCESS (made schedulable, result may
+    nominate a node), UNSCHEDULABLE (ran fine, couldn't help), anything
+    else is an error (reference: framework/v1alpha1/interface.go:278,
+    framework.go:516)."""
+
+    def post_filter(self, state: CycleState, pod: api.Pod,
+                    filtered_node_status: Dict[str, Status]
+                    ) -> Tuple[Optional[PostFilterResult], Status]:
+        raise NotImplementedError
+
+
 class PreScorePlugin(Plugin):
     def pre_score(self, state: CycleState, pod: api.Pod,
                   nodes: List[api.Node]) -> Status:

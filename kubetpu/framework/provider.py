@@ -32,11 +32,17 @@ def default_plugins() -> Plugins:
             Plugin("NodeAffinity"),
             Plugin("VolumeRestrictions"),
             Plugin("TaintToleration"),
+            Plugin("EBSLimits"),
+            Plugin("GCEPDLimits"),
             Plugin("NodeVolumeLimits"),
+            Plugin("AzureDiskLimits"),
             Plugin("VolumeBinding"),
             Plugin("VolumeZone"),
             Plugin("PodTopologySpread"),
             Plugin("InterPodAffinity"),
+        ]),
+        post_filter=PluginSet(enabled=[
+            Plugin("DefaultPreemption"),
         ]),
         pre_score=PluginSet(enabled=[
             Plugin("InterPodAffinity"),

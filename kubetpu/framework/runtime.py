@@ -67,6 +67,8 @@ class Framework:
         self.queue_sort_plugins = point(plugins.queue_sort, fw.QueueSortPlugin)
         self.pre_filter_plugins = point(plugins.pre_filter, fw.PreFilterPlugin)
         self.filter_plugins = point(plugins.filter, fw.FilterPlugin)
+        self.post_filter_plugins = point(plugins.post_filter,
+                                         fw.PostFilterPlugin)
         self.pre_score_plugins = point(plugins.pre_score, fw.PreScorePlugin)
         self.score_plugins = point(plugins.score, fw.ScorePlugin)
         self.score_weights = {p.name: p.weight or 1
@@ -266,6 +268,23 @@ class Framework:
                     f'error while running "{p.name()}" prebind plugin: '
                     f'{st.message()}')
         return Status.success()
+
+    def run_post_filter_plugins(self, state: CycleState, pod: api.Pod,
+                                filtered_node_status=None):
+        """reference: framework.go:514 RunPostFilterPlugins — run until the
+        first SUCCESS or error; UNSCHEDULABLE statuses accumulate.  Returns
+        (PostFilterResult or None, Status)."""
+        reasons: List[str] = []
+        for p in self.post_filter_plugins:
+            r, st = p.post_filter(state, pod, filtered_node_status or {})
+            if st.is_success():
+                return r, st
+            if not st.is_unschedulable():
+                return None, Status.error(
+                    f'error while running "{p.name()}" postfilter plugin: '
+                    f'{st.message()}')
+            reasons.extend(st.reasons)
+        return None, Status(Code.UNSCHEDULABLE, reasons)
 
     def run_bind_plugins(self, state: CycleState, pod: api.Pod,
                          node_name: str) -> Status:

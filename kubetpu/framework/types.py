@@ -60,27 +60,34 @@ def compute_pod_resource_limits(pod: api.Pod) -> Resource:
 
 
 def non_zero_request(pod: api.Pod) -> Tuple[int, int]:
-    """(milli_cpu, memory) where each *container* with a zero request is
-    defaulted to 100m / 200MB, aggregated with the same
-    max(sum(containers), init) + overhead rule.
+    """(milli_cpu, memory) where each *container* with an UNSET request is
+    defaulted to 100m / 200MB — "override if un-set, but not if explicitly
+    set to zero" — aggregated with the same max(sum(containers), init) +
+    overhead rule.
 
-    reference: pkg/scheduler/util/non_zero.go:30-48
+    reference: pkg/scheduler/util/non_zero.go:50-63
     (GetNonzeroRequestForResource, applied per container in
     types.go:432 calculateResource and
     noderesources/resource_allocation.go:118 calculatePodResourceRequest).
     """
     from ..api.resource import to_int, to_milli
+
+    def one(requests):
+        c = (to_milli(requests["cpu"]) if "cpu" in requests
+             else DEFAULT_MILLI_CPU_REQUEST)
+        m = (to_int(requests["memory"]) if "memory" in requests
+             else DEFAULT_MEMORY_REQUEST)
+        return c, m
+
     cpu = mem = 0
     for c in pod.spec.containers:
-        ccpu = to_milli(c.resources.requests.get("cpu", 0))
-        cmem = to_int(c.resources.requests.get("memory", 0))
-        cpu += ccpu if ccpu != 0 else DEFAULT_MILLI_CPU_REQUEST
-        mem += cmem if cmem != 0 else DEFAULT_MEMORY_REQUEST
+        ccpu, cmem = one(c.resources.requests)
+        cpu += ccpu
+        mem += cmem
     for ic in pod.spec.init_containers:
-        ccpu = to_milli(ic.resources.requests.get("cpu", 0))
-        cmem = to_int(ic.resources.requests.get("memory", 0))
-        cpu = max(cpu, ccpu if ccpu != 0 else DEFAULT_MILLI_CPU_REQUEST)
-        mem = max(mem, cmem if cmem != 0 else DEFAULT_MEMORY_REQUEST)
+        ccpu, cmem = one(ic.resources.requests)
+        cpu = max(cpu, ccpu)
+        mem = max(mem, cmem)
     if pod.spec.overhead:
         cpu += to_milli(pod.spec.overhead.get("cpu", 0))
         mem += to_int(pod.spec.overhead.get("memory", 0))

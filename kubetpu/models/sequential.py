@@ -397,24 +397,17 @@ def schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
         req_mem = nz_req[:, 1] + batch.nonzero_req[i, 1]
 
         if "NodeResourcesBalancedAllocation" in score_w:
-            cf = jnp.where(alloc_cpu > 0, req_cpu / jnp.maximum(alloc_cpu, 1.0), 1.0)
-            mf = jnp.where(alloc_mem > 0, req_mem / jnp.maximum(alloc_mem, 1.0), 1.0)
-            s = jnp.where((cf >= 1.0) | (mf >= 1.0), 0.0,
-                          jnp.floor((1.0 - jnp.abs(cf - mf)) * K.MAX_NODE_SCORE))
+            s = K.balanced_formula(req_cpu, req_mem, alloc_cpu, alloc_mem)
             total += jnp.where(feas, s, 0.0) * score_w["NodeResourcesBalancedAllocation"]
 
         if "NodeResourcesLeastAllocated" in score_w:
-            def least(req, cap):
-                s = K._idiv((cap - req) * K.MAX_NODE_SCORE, jnp.maximum(cap, 1.0))
-                return jnp.where((cap <= 0) | (req > cap), 0.0, s)
-            s = K._idiv(least(req_cpu, alloc_cpu) + least(req_mem, alloc_mem), 2.0)
+            s = K._idiv(K.least_formula(req_cpu, alloc_cpu)
+                        + K.least_formula(req_mem, alloc_mem), 2.0)
             total += jnp.where(feas, s, 0.0) * score_w["NodeResourcesLeastAllocated"]
 
         if "NodeResourcesMostAllocated" in score_w:
-            def most(req, cap):
-                s = K._idiv(req * K.MAX_NODE_SCORE, jnp.maximum(cap, 1.0))
-                return jnp.where((cap <= 0) | (req > cap), 0.0, s)
-            s = K._idiv(most(req_cpu, alloc_cpu) + most(req_mem, alloc_mem), 2.0)
+            s = K._idiv(K.most_formula(req_cpu, alloc_cpu)
+                        + K.most_formula(req_mem, alloc_mem), 2.0)
             total += jnp.where(feas, s, 0.0) * score_w["NodeResourcesMostAllocated"]
 
         if image_score is not None:

@@ -501,6 +501,15 @@ def interpod_score(cluster, batch, feasible,
 # resource scorers
 
 
+def _safe_den(cap):
+    """Division guard that preserves sub-unit capacities: the old
+    maximum(cap, 1.0) clamp silently zeroed fractions for capacities under
+    one unit (e.g. byte-scale memory in the reference's test tables, which
+    land below 1 MiB after channel conversion).  Only true zero is
+    redirected (the caller masks that case)."""
+    return jnp.where(cap > 0, cap, 1.0)
+
+
 def _alloc_req(cluster, batch):
     """(requested-with-pod, allocatable) for cpu/mem using NonZeroRequested
     (reference: noderesources/resource_allocation.go:108-117)."""
@@ -511,15 +520,35 @@ def _alloc_req(cluster, batch):
     return req_cpu, req_mem, alloc_cpu, alloc_mem
 
 
-def balanced_allocation_score(cluster, batch) -> jnp.ndarray:
-    """(1 - |cpuFraction - memFraction|) * MaxNodeScore
+def balanced_formula(req_cpu, req_mem, alloc_cpu, alloc_mem) -> jnp.ndarray:
+    """(1 - |cpuFraction - memFraction|) * MaxNodeScore — the formula shared
+    by the batch kernel and the sequential scan
     (reference: noderesources/balanced_allocation.go:83-113)."""
-    req_cpu, req_mem, alloc_cpu, alloc_mem = _alloc_req(cluster, batch)
-    cpu_frac = jnp.where(alloc_cpu > 0, req_cpu / jnp.maximum(alloc_cpu, 1.0), 1.0)
-    mem_frac = jnp.where(alloc_mem > 0, req_mem / jnp.maximum(alloc_mem, 1.0), 1.0)
+    cpu_frac = jnp.where(alloc_cpu > 0, req_cpu / _safe_den(alloc_cpu), 1.0)
+    mem_frac = jnp.where(alloc_mem > 0, req_mem / _safe_den(alloc_mem), 1.0)
     diff = jnp.abs(cpu_frac - mem_frac)
-    score = jnp.floor((1.0 - diff) * MAX_NODE_SCORE)
+    # the reference truncates a float64 product (balanced_allocation.go:103);
+    # two f32 divisions can land an ulp under the true value (e.g.
+    # 74.999997 for a true 75), so compensate before the floor
+    score = jnp.floor((1.0 - diff) * MAX_NODE_SCORE + 1e-4)
     return jnp.where((cpu_frac >= 1.0) | (mem_frac >= 1.0), 0.0, score)
+
+
+def least_formula(req, cap) -> jnp.ndarray:
+    """(capacity - requested) * MaxNodeScore / capacity
+    (reference: least_allocated.go:95-117)."""
+    s = _idiv((cap - req) * MAX_NODE_SCORE, _safe_den(cap))
+    return jnp.where((cap <= 0) | (req > cap), 0.0, s)
+
+
+def most_formula(req, cap) -> jnp.ndarray:
+    """requested * MaxNodeScore / capacity (reference: most_allocated.go:101)."""
+    s = _idiv(req * MAX_NODE_SCORE, _safe_den(cap))
+    return jnp.where((cap <= 0) | (req > cap), 0.0, s)
+
+
+def balanced_allocation_score(cluster, batch) -> jnp.ndarray:
+    return balanced_formula(*_alloc_req(cluster, batch))
 
 
 def _weighted_resource_score(cluster, batch, per_resource, cpu_weight=1.0,
@@ -532,20 +561,11 @@ def _weighted_resource_score(cluster, batch, per_resource, cpu_weight=1.0,
 
 
 def least_allocated_score(cluster, batch) -> jnp.ndarray:
-    """(capacity - requested) * MaxNodeScore / capacity per resource, averaged
-    (reference: noderesources/least_allocated.go:95-117)."""
-    def one(req, cap):
-        s = _idiv((cap - req) * MAX_NODE_SCORE, jnp.maximum(cap, 1.0))
-        return jnp.where((cap <= 0) | (req > cap), 0.0, s)
-    return _weighted_resource_score(cluster, batch, one)
+    return _weighted_resource_score(cluster, batch, least_formula)
 
 
 def most_allocated_score(cluster, batch) -> jnp.ndarray:
-    """requested * MaxNodeScore / capacity (reference: most_allocated.go:101-117)."""
-    def one(req, cap):
-        s = _idiv(req * MAX_NODE_SCORE, jnp.maximum(cap, 1.0))
-        return jnp.where((cap <= 0) | (req > cap), 0.0, s)
-    return _weighted_resource_score(cluster, batch, one)
+    return _weighted_resource_score(cluster, batch, most_formula)
 
 
 # ---------------------------------------------------------------------------

@@ -301,6 +301,39 @@ class DefaultBinder(fw.BindPlugin):
         return Status.success()
 
 
+class DefaultPreemption(fw.PostFilterPlugin):
+    """Preemption as the PostFilter extension point (the reference's TODO
+    realized in later releases: defaultpreemption.DefaultPreemption; for
+    this vintage the behavior lives in generic_scheduler.go:252 Preempt,
+    invoked from scheduler.go:391).  The Preemptor instance is late-bound
+    by the Scheduler after construction; the cycle's shared tensors arrive
+    through CycleState under CYCLE_CONTEXT_KEY."""
+    NAME = "DefaultPreemption"
+    CYCLE_CONTEXT_KEY = "kubetpu.io/cycle-context"
+
+    def __init__(self, handle=None):
+        self.handle = handle
+        self.preemptor = None   # set by Scheduler.__init__
+
+    def name(self) -> str:
+        return self.NAME
+
+    def post_filter(self, state, pod, filtered_node_status):
+        if self.preemptor is None:
+            return None, Status.unschedulable("preemption disabled")
+        try:
+            cycle = state.read(self.CYCLE_CONTEXT_KEY)
+        except KeyError:
+            cycle = None
+        nominated = self.preemptor.preempt(self.handle, state, pod,
+                                           cycle=cycle)
+        if nominated:
+            return fw.PostFilterResult(nominated), Status.success()
+        return None, Status.unschedulable(
+            "preemption: 0/%d nodes are available" %
+            len(filtered_node_status or {}))
+
+
 # ---------------------------------------------------------------------------
 # registry
 
@@ -313,6 +346,8 @@ def new_in_tree_registry() -> Registry:
     from . import volumes
     return {
         PrioritySort.NAME: lambda args=None, handle=None: PrioritySort(),
+        DefaultPreemption.NAME:
+            lambda args=None, handle=None: DefaultPreemption(handle=handle),
         NodeResourcesFit.NAME: lambda args=None, handle=None: NodeResourcesFit(),
         NodeResourcesLeastAllocated.NAME:
             lambda args=None, handle=None: NodeResourcesLeastAllocated(),
@@ -352,5 +387,17 @@ def new_in_tree_registry() -> Registry:
                 store=handle.client if handle else None),
         volumes.NodeVolumeLimits.NAME:
             lambda args=None, handle=None: volumes.NodeVolumeLimits(
+                store=handle.client if handle else None),
+        volumes.EBSLimits.NAME:
+            lambda args=None, handle=None: volumes.EBSLimits(
+                store=handle.client if handle else None),
+        volumes.GCEPDLimits.NAME:
+            lambda args=None, handle=None: volumes.GCEPDLimits(
+                store=handle.client if handle else None),
+        volumes.AzureDiskLimits.NAME:
+            lambda args=None, handle=None: volumes.AzureDiskLimits(
+                store=handle.client if handle else None),
+        volumes.CinderLimits.NAME:
+            lambda args=None, handle=None: volumes.CinderLimits(
                 store=handle.client if handle else None),
     }

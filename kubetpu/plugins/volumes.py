@@ -31,10 +31,6 @@ ERR_REASON_MAX_VOLUME_COUNT = "node(s) exceed max volume count"
 _ZONE_KEYS = (api.LABEL_ZONE, api.LABEL_REGION, api.LABEL_ZONE_LEGACY,
               api.LABEL_REGION_LEGACY)
 
-DEFAULT_EBS_LIMIT = 39    # reference: non_csi.go:50 defaultMaxEBSVolumes
-DEFAULT_GCE_PD_LIMIT = 16  # reference: non_csi.go:56 DefaultMaxGCEPDVolumes
-
-
 class _VolumePlugin(fw.Plugin):
     def __init__(self, store=None):
         self.store = store
@@ -251,21 +247,28 @@ class VolumeZone(_VolumePlugin, fw.FilterPlugin):
 
 
 class NodeVolumeLimits(_VolumePlugin, fw.FilterPlugin):
-    """Attachable-volume count limits, CSI + in-tree
-    (reference: nodevolumelimits/csi.go + non_csi.go:522)."""
+    """CSI attachable-volume count limits (reference: nodevolumelimits/
+    csi.go:62 — CSIName == "NodeVolumeLimits").  Counts CSI-sourced
+    volumes (PVC -> PV -> spec.csi) per driver against the node's CSINode
+    allocatable; a driver with no CSINode entry has no limit (csi.go:263).
+    In-tree sources are the per-driver plugins' job (EBSLimits etc.);
+    CSI-migration double-counting translation is not implemented."""
     NAME = "NodeVolumeLimits"
 
     def relevant(self, pod: api.Pod) -> bool:
-        return any(v.aws_elastic_block_store or v.gce_persistent_disk
-                   or v.persistent_volume_claim for v in pod.spec.volumes)
+        return any(v.persistent_volume_claim for v in pod.spec.volumes)
 
     def filter(self, state: CycleState, pod: api.Pod, node_info) -> Status:
+        new: Dict[str, Set[str]] = {}
+        self._count_csi(pod, new)
+        if not new:
+            return Status.success()
         limits = self._node_limits(node_info)
+        if not limits:
+            return Status.success()
         counts: Dict[str, Set[str]] = {}
         for pi in node_info.pods:
-            self._count(pi.pod, counts)
-        new: Dict[str, Set[str]] = {}
-        self._count(pod, new)
+            self._count_csi(pi.pod, counts)
         for driver, vols in new.items():
             limit = limits.get(driver)
             if limit is None:
@@ -275,35 +278,132 @@ class NodeVolumeLimits(_VolumePlugin, fw.FilterPlugin):
                 return Status.unschedulable(ERR_REASON_MAX_VOLUME_COUNT)
         return Status.success()
 
-    def _count(self, pod: api.Pod, out: Dict[str, Set[str]]) -> None:
-        """Tally attachable volumes, resolving PVC -> PV -> source like the
-        reference's filterAttachableVolumes (non_csi.go:338, csi.go:180)."""
+    def _count_csi(self, pod: api.Pod, out: Dict[str, Set[str]]) -> None:
+        """PVC -> PV -> csi source (reference: csi.go:180
+        filterAttachableVolumes)."""
         for v in pod.spec.volumes:
-            if v.aws_elastic_block_store:
-                out.setdefault("ebs", set()).add(v.aws_elastic_block_store)
-            if v.gce_persistent_disk:
-                out.setdefault("gce-pd", set()).add(v.gce_persistent_disk)
-            if v.persistent_volume_claim and self.store is not None:
-                pvc = self.store.get_pvc(pod.namespace,
-                                         v.persistent_volume_claim)
-                pv = self._pv(pvc.volume_name) if pvc else None
-                if pv is None:
-                    continue
-                if pv.aws_elastic_block_store:
-                    out.setdefault("ebs", set()).add(pv.aws_elastic_block_store)
-                if pv.gce_persistent_disk:
-                    out.setdefault("gce-pd", set()).add(pv.gce_persistent_disk)
-                if pv.csi_driver:
-                    out.setdefault(pv.csi_driver, set()).add(
-                        pv.csi_volume_handle or pv.metadata.name)
+            if not v.persistent_volume_claim:
+                continue
+            pvc = self._pvc(pod, v.persistent_volume_claim)
+            pv = self._pv(pvc.volume_name) if pvc else None
+            if pv is not None and pv.csi_driver:
+                out.setdefault(pv.csi_driver, set()).add(
+                    pv.csi_volume_handle or pv.metadata.name)
 
     def _node_limits(self, node_info) -> Dict[str, int]:
-        limits = {"ebs": DEFAULT_EBS_LIMIT, "gce-pd": DEFAULT_GCE_PD_LIMIT}
         if self.store is not None and node_info.node is not None:
             csinode = self.store.get_csinode(node_info.node.name)
             if csinode is not None:
-                limits.update(csinode.driver_allocatable)
-        return limits
+                return dict(csinode.driver_allocatable)
+        return {}
+
+
+class _NonCSILimits(_VolumePlugin, fw.FilterPlugin):
+    """One in-tree volume type's attachable count limit (reference:
+    nodevolumelimits/non_csi.go:126 nonCSILimits + the four filter types).
+    Limit resolution order (non_csi.go:310 getMaxVolLimit):
+    node.status.allocatable[<attachable-volumes-key>] ->
+    $KUBE_MAX_PD_VOLS -> the per-type default.  A PVC that cannot be
+    resolved counts against the limit (non_csi.go:230 — unbound claims are
+    assumed to need this type)."""
+    NAME = ""
+    LIMIT_KEY = ""       # volumeutil.*VolumeLimitKey
+    DEFAULT_LIMIT = 0
+
+    def _source(self, v) -> Optional[str]:
+        raise NotImplementedError
+
+    def relevant(self, pod: api.Pod) -> bool:
+        return any(self._source(v) or v.persistent_volume_claim
+                   for v in pod.spec.volumes)
+
+    def _count(self, pod: api.Pod, out: Set[str]) -> None:
+        for v in pod.spec.volumes:
+            src = self._source(v)
+            if src:
+                out.add(src)
+            elif v.persistent_volume_claim:
+                pvc = (self.store.get_pvc(pod.namespace,
+                                          v.persistent_volume_claim)
+                       if self.store else None)
+                pv = self._pv(pvc.volume_name) if pvc else None
+                if pv is None:
+                    # unbound/missing claim: assume this type
+                    # (non_csi.go:230-246)
+                    out.add(f"{pod.namespace}/{v.persistent_volume_claim}")
+                else:
+                    src = self._source(pv)
+                    if src:
+                        out.add(src)
+
+    def filter(self, state: CycleState, pod: api.Pod, node_info) -> Status:
+        new: Set[str] = set()
+        self._count(pod, new)
+        if not new:
+            return Status.success()
+        used: Set[str] = set()
+        for pi in node_info.pods:
+            self._count(pi.pod, used)
+        if len(used | new) > self._max_volumes(node_info):
+            return Status.unschedulable(ERR_REASON_MAX_VOLUME_COUNT)
+        return Status.success()
+
+    def _max_volumes(self, node_info) -> int:
+        import os
+        node = node_info.node
+        if node is not None and self.LIMIT_KEY in node.status.allocatable:
+            try:
+                return int(node.status.allocatable[self.LIMIT_KEY])
+            except (TypeError, ValueError):
+                pass
+        env = os.environ.get("KUBE_MAX_PD_VOLS")
+        if env:
+            try:
+                return int(env)
+            except ValueError:
+                pass
+        return self.DEFAULT_LIMIT
+
+
+class EBSLimits(_NonCSILimits):
+    """reference: non_csi.go:86 EBSName; default 39 (non_csi.go:41)."""
+    NAME = "EBSLimits"
+    LIMIT_KEY = "attachable-volumes-aws-ebs"
+    DEFAULT_LIMIT = 39
+
+    def _source(self, v):
+        return v.aws_elastic_block_store
+
+
+class GCEPDLimits(_NonCSILimits):
+    """reference: non_csi.go:95 GCEPDName; default 16 (non_csi.go:45)."""
+    NAME = "GCEPDLimits"
+    LIMIT_KEY = "attachable-volumes-gce-pd"
+    DEFAULT_LIMIT = 16
+
+    def _source(self, v):
+        return v.gce_persistent_disk
+
+
+class AzureDiskLimits(_NonCSILimits):
+    """reference: non_csi.go:68 AzureDiskName; default 16 (non_csi.go:49)."""
+    NAME = "AzureDiskLimits"
+    LIMIT_KEY = "attachable-volumes-azure-disk"
+    DEFAULT_LIMIT = 16
+
+    def _source(self, v):
+        return v.azure_disk
+
+
+class CinderLimits(_NonCSILimits):
+    """reference: non_csi.go:77 CinderName; default 256
+    (volume_stats.go DefaultMaxCinderVolumes)."""
+    NAME = "CinderLimits"
+    LIMIT_KEY = "attachable-volumes-cinder"
+    DEFAULT_LIMIT = 256
+
+    def _source(self, v):
+        return v.cinder
 
 
 def _pv_matches_node(pv: api.PersistentVolume, node: api.Node) -> bool:

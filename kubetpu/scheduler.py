@@ -108,6 +108,14 @@ class Scheduler:
         else:
             from .preemption import Preemptor
             self.preemptor = Preemptor(self)
+        # preemption is served through the PostFilter extension point
+        # (DefaultPreemption); the Preemptor instance is late-bound because
+        # it needs the scheduler itself
+        from .plugins.intree import DefaultPreemption
+        for fwk in self.profiles.values():
+            for p in fwk.post_filter_plugins:
+                if isinstance(p, DefaultPreemption):
+                    p.preemptor = self.preemptor
 
     # ------------------------------------------------------------------ events
 
@@ -659,12 +667,17 @@ class Scheduler:
               preemption_may_help: bool = True,
               cycle=None) -> ScheduleOutcome:
         """reference: scheduler.go:391 recordSchedulingFailure +
-        :542-563 (preemption trigger + requeue + condition patch)."""
+        :542-563 — preemption now runs behind the PostFilter extension
+        point (framework.go:516; DefaultPreemption)."""
         pod = qp.pod
         nominated = ""
-        if preemption_may_help and self.preemptor is not None:
-            nominated = self.preemptor.preempt(fwk, state, pod,
-                                               cycle=cycle) or ""
+        if preemption_may_help and fwk.post_filter_plugins:
+            from .plugins.intree import DefaultPreemption
+            if cycle is not None:
+                state.write(DefaultPreemption.CYCLE_CONTEXT_KEY, cycle)
+            result, st = fwk.run_post_filter_plugins(state, pod)
+            if st.is_success() and result is not None:
+                nominated = result.nominated_node_name
         self._record_failure(fwk, qp, message, nominated)
         return ScheduleOutcome(pod=pod, node="", err=message,
                                preemption_may_help=preemption_may_help)

@@ -82,10 +82,14 @@ def test_lease_loss_is_fatal(tmp_path):
             time.sleep(0.1)
         else:
             pytest.fail("scheduler never acquired the lease")
-        # steal the lease from outside the process
-        lock.write_text(json.dumps({
-            "holder": "usurper", "acquire_time": time.time(),
-            "renew_time": time.time() + 3600, "lease_duration": 3600}))
+        # steal the lease from outside the process through the production
+        # lock (flock + atomic replace) so the victim's reader can never
+        # observe a torn write
+        from kubetpu.utils.leaderelection import FileLock, LeaseRecord
+        flock = FileLock(str(lock))
+        rec = LeaseRecord(holder="usurper", acquire_time=time.time(),
+                          renew_time=time.time() + 3600, lease_duration=3600)
+        flock._flocked(lambda: flock._write(rec))
         rc = proc.wait(timeout=60)
         assert rc == 1
         out = proc.stdout.read()

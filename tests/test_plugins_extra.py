@@ -23,6 +23,9 @@ def test_requested_to_capacity_ratio_kernel():
              hollow.make_node("half", cpu_milli=1000, mem=1000 << 20)]
     existing = {"half": [hollow.make_pod("e", cpu_milli=500, mem=500 << 20)]}
     pod = hollow.make_pod("p", cpu_milli=0, mem=0)
+    # UNSET requests take the non-zero defaults (100m/200MB); explicit
+    # zeros would stay zero (non_zero.go:53 "not if explicitly set to zero")
+    pod.spec.containers[0].resources.requests = {}
     res = run_cluster(
         nodes, existing, [pod],
         filters=("NodeResourcesFit",),

@@ -92,10 +92,11 @@ def test_commit_time_host_filter_recheck():
     (attachable volumes): the second pod's commit re-validates host filters
     against the live NodeInfo that already holds the first assume."""
     store = ClusterStore()
-    store.add(hollow.make_node("n1", cpu_milli=8000))
-    # allow exactly ONE EBS volume on the node
-    store.add(api.CSINode(metadata=api.ObjectMeta(name="n1"),
-                          driver_allocatable={"ebs": 1}))
+    node = hollow.make_node("n1", cpu_milli=8000)
+    # allow exactly ONE EBS volume on the node (non_csi.go:310 reads the
+    # attachable-volumes allocatable key)
+    node.status.allocatable["attachable-volumes-aws-ebs"] = "1"
+    store.add(node)
     sched = Scheduler(store, async_binding=False)
     for i in range(2):
         p = hollow.make_pod(f"ebs-{i}", cpu_milli=100)

@@ -1,0 +1,271 @@
+"""Per-driver attachable-volume limit goldens, ported with literal inputs
+from the reference tables (reference:
+pkg/scheduler/framework/plugins/nodevolumelimits/non_csi_test.go and
+csi_test.go), plus PostFilter runner semantics (framework.go:514)."""
+from typing import List
+
+from kubetpu.api import types as api
+from kubetpu.client.store import ClusterStore
+from kubetpu.framework import interface as fw
+from kubetpu.framework.interface import Code, CycleState, Status
+from kubetpu.framework.types import NodeInfo
+from kubetpu.plugins import volumes
+from tests.test_tensors import mknode, mkpod
+
+
+def pod_with(vols: List[api.Volume], name="p") -> api.Pod:
+    p = mkpod(name=name)
+    p.spec.volumes = vols
+    return p
+
+
+def ebs(vid):
+    return api.Volume(name=vid, aws_elastic_block_store=vid)
+
+
+def cinder(vid):
+    return api.Volume(name=vid, cinder=vid)
+
+
+def pvc(claim):
+    return api.Volume(name=claim, persistent_volume_claim=claim)
+
+
+def node_info(max_vols: int, limit_key: str, existing: List[api.Pod]):
+    n = mknode(name="node")
+    n.status.allocatable[limit_key] = str(max_vols)
+    ni = NodeInfo(n)
+    for p in existing:
+        p.spec.node_name = "node"
+        ni.add_pod(p)
+    return ni
+
+
+# fixture pods (non_csi_test.go:438-466: oneVolPod, twoVolPod, splitVolsPod,
+# nonApplicablePod, deletedPVCPod)
+def one_vol():
+    return pod_with([ebs("ovp")], name="one")
+
+
+def two_vol():
+    return pod_with([ebs("tvp1"), ebs("tvp2")], name="two")
+
+
+def split_vols():
+    # hostPath (not modeled; an empty-source volume is equivalent) + one EBS
+    return pod_with([api.Volume(name="hp"), ebs("svp")], name="split")
+
+
+def non_applicable():
+    return pod_with([api.Volume(name="hp")], name="na")
+
+
+def deleted_pvc_pod():
+    return pod_with([pvc("deletedPVC")], name="delpvc")
+
+
+def run_ebs(new_pod, existing, max_vols, store=None):
+    p = volumes.EBSLimits(store=store or ClusterStore())
+    ni = node_info(max_vols, "attachable-volumes-aws-ebs", existing)
+    return p.filter(CycleState(), new_pod, ni)
+
+
+class TestEBSLimits:
+    def test_fits_when_capacity_sufficient(self):
+        # non_csi_test.go table: "fits when node capacity >= new pod's
+        # EBS volumes" — existing {tvp1,tvp2,ovp}, new re-mounts ovp
+        st = run_ebs(one_vol(), [two_vol(), one_vol()], max_vols=4)
+        assert st.is_success()
+
+    def test_not_fit_when_capacity_low(self):
+        # "doesn't fit when node capacity < new pod's EBS volumes"
+        st = run_ebs(two_vol(), [one_vol()], max_vols=2)
+        assert not st.is_success()
+        assert volumes.ERR_REASON_MAX_VOLUME_COUNT in st.message()
+
+    def test_new_pod_ignores_non_ebs(self):
+        # "new pod's count ignores non-EBS volumes"
+        st = run_ebs(split_vols(), [two_vol()], max_vols=3)
+        assert st.is_success()
+
+    def test_existing_pods_ignore_non_ebs(self):
+        # "existing pods' counts ignore non-EBS volumes"
+        st = run_ebs(two_vol(), [split_vols(), non_applicable()], max_vols=3)
+        assert st.is_success()
+
+    def test_same_volume_not_double_counted(self):
+        # "the same EBS volumes are not counted multiple times"
+        st = run_ebs(split_vols(), [one_vol(), one_vol()], max_vols=2)
+        assert st.is_success()
+
+    def test_missing_pvc_counts_toward_limit(self):
+        # "pod with missing PVC is counted towards the PV limit"
+        st = run_ebs(pod_with([pvc("newPVC")], name="newpvc"),
+                     [one_vol(), deleted_pvc_pod()], max_vols=2)
+        assert not st.is_success()
+
+    def test_two_missing_pvcs_count_twice(self):
+        # "two pods missing different PVCs are counted towards the PV limit
+        # twice"
+        two_deleted = pod_with([pvc("deletedPVC"), pvc("anotherDeletedPVC")],
+                               name="twodel")
+        st = run_ebs(pod_with([pvc("newPVC")], name="newpvc"),
+                     [two_deleted], max_vols=2)
+        assert not st.is_success()
+
+    def test_pvc_backed_by_ebs_counts(self):
+        # "new pod's count considers PVCs backed by EBS volumes"
+        store = ClusterStore()
+        store.add(api.PersistentVolume(
+            metadata=api.ObjectMeta(name="pv-ebs"),
+            aws_elastic_block_store="pv-vol"))
+        c = api.PersistentVolumeClaim(metadata=api.ObjectMeta(name="c1"))
+        c.volume_name = "pv-ebs"
+        store.add(c)
+        st = run_ebs(pod_with([pvc("c1")], name="claimed"),
+                     [two_vol(), one_vol()], max_vols=3, store=store)
+        assert not st.is_success()   # {tvp1,tvp2,ovp} + pv-vol = 4 > 3
+
+    def test_env_override(self, monkeypatch):
+        # non_csi.go:343 KUBE_MAX_PD_VOLS
+        monkeypatch.setenv("KUBE_MAX_PD_VOLS", "2")
+        p = volumes.EBSLimits(store=ClusterStore())
+        ni = NodeInfo(mknode(name="node"))   # no allocatable limit key
+        st = p.filter(CycleState(), two_vol(), ni)
+        assert st.is_success()               # exactly 2 == limit
+        three = pod_with([ebs("a"), ebs("b"), ebs("c")], name="three")
+        assert not p.filter(CycleState(), three, ni).is_success()
+
+
+class TestCinderLimits:
+    # non_csi_test.go:410-424 (the two Cinder rows, literal)
+    def test_fits_at_4(self):
+        p = volumes.CinderLimits(store=ClusterStore())
+        ni = node_info(4, "attachable-volumes-cinder",
+                       [pod_with([cinder("tvp1"), cinder("tvp2")], "two")])
+        st = p.filter(CycleState(), pod_with([cinder("ovp")], "one"), ni)
+        assert st.is_success()
+
+    def test_not_fit_at_2(self):
+        p = volumes.CinderLimits(store=ClusterStore())
+        ni = node_info(2, "attachable-volumes-cinder",
+                       [pod_with([cinder("tvp1"), cinder("tvp2")], "two")])
+        st = p.filter(CycleState(), pod_with([cinder("ovp")], "one"), ni)
+        assert not st.is_success()
+        assert volumes.ERR_REASON_MAX_VOLUME_COUNT in st.message()
+
+
+class TestAzureDiskLimits:
+    def test_counts_only_azure(self):
+        p = volumes.AzureDiskLimits(store=ClusterStore())
+        ni = node_info(1, "attachable-volumes-azure-disk",
+                       [pod_with([ebs("e1")], "ebs-pod")])
+        az = pod_with([api.Volume(name="d1", azure_disk="d1")], "az")
+        assert p.filter(CycleState(), az, ni).is_success()
+        az2 = pod_with([api.Volume(name="d1", azure_disk="d1"),
+                        api.Volume(name="d2", azure_disk="d2")], "az2")
+        assert not p.filter(CycleState(), az2, ni).is_success()
+
+
+class TestCSILimits:
+    def _store(self, driver="ebs.csi.aws.com", limit=2):
+        store = ClusterStore()
+        store.add(api.CSINode(metadata=api.ObjectMeta(name="node"),
+                              driver_allocatable={driver: limit}))
+        for i in range(3):
+            store.add(api.PersistentVolume(
+                metadata=api.ObjectMeta(name=f"pv-{i}"),
+                csi_driver=driver, csi_volume_handle=f"vol-{i}"))
+            c = api.PersistentVolumeClaim(
+                metadata=api.ObjectMeta(name=f"c{i}"))
+            c.volume_name = f"pv-{i}"
+            store.add(c)
+        return store
+
+    def test_csinode_limit_enforced(self):
+        # csi_test.go: "doesn't when node volume limit <= pods CSI volume"
+        store = self._store(limit=2)
+        p = volumes.NodeVolumeLimits(store=store)
+        ni = NodeInfo(mknode(name="node"))
+        existing = pod_with([pvc("c0"), pvc("c1")], "uses-two")
+        existing.spec.node_name = "node"
+        ni.add_pod(existing)
+        st = p.filter(CycleState(), pod_with([pvc("c2")], "third"), ni)
+        assert not st.is_success()
+
+    def test_no_csinode_means_no_limit(self):
+        # csi.go:263 — no CSINode => limits unknown => pass
+        store = self._store()
+        store._objs["CSINode"].clear()
+        p = volumes.NodeVolumeLimits(store=store)
+        ni = NodeInfo(mknode(name="node"))
+        st = p.filter(CycleState(), pod_with([pvc("c0")], "one"), ni)
+        assert st.is_success()
+
+
+class _InfoPostFilter(fw.PostFilterPlugin):
+    """Informational plugin: always Unschedulable (interface.go:286)."""
+    calls = []
+
+    def name(self):
+        return "Info"
+
+    def post_filter(self, state, pod, filtered):
+        self.calls.append("info")
+        return None, Status.unschedulable("info ran")
+
+
+class _NominatingPostFilter(fw.PostFilterPlugin):
+    def name(self):
+        return "Nominator"
+
+    def post_filter(self, state, pod, filtered):
+        return fw.PostFilterResult("node-x"), Status.success()
+
+
+class _ErrorPostFilter(fw.PostFilterPlugin):
+    def name(self):
+        return "Boom"
+
+    def post_filter(self, state, pod, filtered):
+        return None, Status.error("boom")
+
+
+def _fwk_with(post_filters):
+    from kubetpu.apis.config import (KubeSchedulerProfile, Plugin, Plugins,
+                                     PluginSet)
+    from kubetpu.framework.runtime import Framework
+    from kubetpu.plugins.intree import new_in_tree_registry
+    registry = dict(new_in_tree_registry())
+    for inst in post_filters:
+        registry[inst.name()] = (
+            lambda args=None, handle=None, _i=inst: _i)
+    prof = KubeSchedulerProfile(plugins=Plugins(
+        post_filter=PluginSet(
+            enabled=[Plugin(name=i.name()) for i in post_filters],
+            disabled=[Plugin(name="*")])))
+    return Framework(registry, prof)
+
+
+class TestPostFilterRunner:
+    def test_first_success_wins(self):
+        # framework.go:514: run until the first Success
+        _InfoPostFilter.calls = []
+        fwk = _fwk_with([_InfoPostFilter(), _NominatingPostFilter()])
+        r, st = fwk.run_post_filter_plugins(CycleState(), mkpod(name="p"))
+        assert st.is_success()
+        assert r.nominated_node_name == "node-x"
+        assert _InfoPostFilter.calls == ["info"]
+
+    def test_all_unschedulable_merges(self):
+        fwk = _fwk_with([_InfoPostFilter()])
+        r, st = fwk.run_post_filter_plugins(CycleState(), mkpod(name="p"))
+        assert r is None
+        assert st.code == Code.UNSCHEDULABLE
+        assert "info ran" in st.message()
+
+    def test_error_aborts(self):
+        fwk = _fwk_with([_ErrorPostFilter(), _NominatingPostFilter()])
+        r, st = fwk.run_post_filter_plugins(CycleState(), mkpod(name="p"))
+        assert r is None
+        assert st.code == Code.ERROR
