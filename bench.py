@@ -50,7 +50,8 @@ def build_world(n_nodes, n_pods, existing_per_node, store=None):
     return store, pending
 
 
-def run_mode(mode, n_nodes, n_pods, existing_per_node, repeats):
+def run_mode(mode, n_nodes, n_pods, existing_per_node, repeats,
+             mesh_shape=None):
     """One full e2e measurement: fresh store + scheduler per attempt; the
     first attempt pays XLA compiles (reported as compile_s), later attempts
     reuse the jit cache inside this process."""
@@ -65,7 +66,8 @@ def run_mode(mode, n_nodes, n_pods, existing_per_node, repeats):
     for attempt in range(repeats + 1):
         store, pending = build_world(n_nodes, n_pods, existing_per_node)
         cfg = KubeSchedulerConfiguration(profiles=[KubeSchedulerProfile()],
-                                         batch_size=n_pods, mode=mode)
+                                         batch_size=n_pods, mode=mode,
+                                         mesh_shape=mesh_shape)
         sched = Scheduler(store, config=cfg, async_binding=False)
         for p in pending:
             store.add(p)
@@ -125,6 +127,20 @@ def main() -> None:
     repeats = int(os.environ.get("BENCH_REPEATS", "2"))
     modes = os.environ.get("BENCH_MODES", "gang,sequential").split(",")
 
+    mesh_shape = None
+    if os.environ.get("BENCH_MESH"):
+        mesh_shape = tuple(int(x) for x in
+                           os.environ["BENCH_MESH"].split(","))
+        # make sure a virtual CPU mesh of the requested size exists before
+        # jax initializes (make_mesh falls back to CPU devices when the
+        # default platform can't satisfy the shape); REPLACE any smaller
+        # pre-existing device-count flag
+        need = mesh_shape[0] * mesh_shape[1]
+        flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(f"--xla_force_host_platform_device_count={need}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+
     from kubetpu.utils.compilation import enable_persistent_cache
     enable_persistent_cache()
     import jax
@@ -134,7 +150,8 @@ def main() -> None:
     headline = None
     for mode in modes:
         best, first, outcomes, sched = run_mode(
-            mode, n_nodes, n_pods, existing_per_node, repeats)
+            mode, n_nodes, n_pods, existing_per_node, repeats,
+            mesh_shape=mesh_shape)
         scheduled = sum(1 for o in outcomes if o.node)
         d = {"e2e_best_s": round(best, 3),
              "first_cycle_s": round(first, 3),

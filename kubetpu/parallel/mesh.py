@@ -55,8 +55,21 @@ POD_AXIS_FIELDS = frozenset({
 def make_mesh(shape: Optional[Tuple[int, int]] = None,
               devices=None) -> Mesh:
     """Build a ("pods", "nodes") mesh.  Default shape puts all devices on
-    the node axis (the reference's only intra-cycle parallel axis)."""
-    devices = list(devices if devices is not None else jax.devices())
+    the node axis (the reference's only intra-cycle parallel axis).  When
+    the default platform cannot satisfy the requested shape (e.g. one
+    tunneled TPU chip) but a virtual CPU mesh can
+    (--xla_force_host_platform_device_count), fall back to CPU devices so
+    the sharded path stays testable without N real chips."""
+    if devices is None:
+        devices = jax.devices()
+        if shape is not None and shape[0] * shape[1] != len(devices):
+            try:
+                cpus = jax.devices("cpu")
+            except RuntimeError:
+                cpus = []
+            if shape[0] * shape[1] == len(cpus):
+                devices = cpus
+    devices = list(devices)
     n = len(devices)
     if shape is None:
         shape = (1, n)
@@ -66,6 +79,18 @@ def make_mesh(shape: Optional[Tuple[int, int]] = None,
     return Mesh(arr, (AXIS_PODS, AXIS_NODES))
 
 
+def _put(x, sharding: NamedSharding):
+    """device_put that also works on MULTI-PROCESS meshes: for
+    non-fully-addressable shardings, build the global array from each
+    process's addressable shards (device_put would run a cross-process
+    same-value assert that trips on NaN padding — NaN != NaN)."""
+    if getattr(sharding, "is_fully_addressable", True):
+        return jax.device_put(x, sharding)
+    arr = np.asarray(x)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])
+
+
 def shard_cluster(cluster: ClusterTensors, mesh: Mesh,
                   shard_existing_pods: bool = True) -> ClusterTensors:
     """device_put a host/replicated ClusterTensors onto the mesh."""
@@ -73,13 +98,12 @@ def shard_cluster(cluster: ClusterTensors, mesh: Mesh,
     for field in ClusterTensors._fields:
         val = getattr(cluster, field)
         if field in NODE_AXIS_FIELDS:
-            spec = P(AXIS_NODES)
-            out[field] = jax.device_put(val, NamedSharding(mesh, spec))
+            out[field] = _put(val, NamedSharding(mesh, P(AXIS_NODES)))
         elif field in POD_AXIS_FIELDS and shard_existing_pods:
-            out[field] = jax.device_put(val, NamedSharding(mesh, P(AXIS_PODS)))
+            out[field] = _put(val, NamedSharding(mesh, P(AXIS_PODS)))
         else:
             out[field] = jax.tree.map(
-                lambda x: jax.device_put(x, NamedSharding(mesh, P())), val)
+                lambda x: _put(x, NamedSharding(mesh, P())), val)
     return ClusterTensors(**out)
 
 
@@ -92,14 +116,14 @@ def shard_batch(batch, mesh: Mesh):
     def put(x):
         x = np.asarray(x)
         if x.ndim >= 1 and x.shape[0] % n == 0:
-            return jax.device_put(x, NamedSharding(mesh, P(AXIS_PODS)))
-        return jax.device_put(x, NamedSharding(mesh, P()))
+            return _put(x, NamedSharding(mesh, P(AXIS_PODS)))
+        return _put(x, NamedSharding(mesh, P()))
     return jax.tree.map(put, batch)
 
 
 def replicate(tree, mesh: Mesh):
     return jax.tree.map(
-        lambda x: jax.device_put(x, NamedSharding(mesh, P())), tree)
+        lambda x: _put(x, NamedSharding(mesh, P())), tree)
 
 
 def sharded_schedule_batch(cluster, batch, cfg: programs.ProgramConfig, rng,
@@ -109,34 +133,65 @@ def sharded_schedule_batch(cluster, batch, cfg: programs.ProgramConfig, rng,
     SPMD partitioner derives every intermediate sharding + collective."""
     cluster = shard_cluster(cluster, mesh, shard_existing_pods)
     batch = shard_batch(batch, mesh)
-    rng = jax.device_put(rng, NamedSharding(mesh, P()))
+    rng = _put(rng, NamedSharding(mesh, P()))
     with jax.set_mesh(mesh):
         return programs.schedule_batch(cluster, batch, cfg, rng)
 
 
+def sharded_filter_and_score(cluster, batch, cfg: programs.ProgramConfig,
+                             mesh: Mesh, host_ok=None,
+                             shard_existing_pods: bool = True):
+    """filter_and_score over the mesh (the extender path's device half)."""
+    cluster = shard_cluster(cluster, mesh, shard_existing_pods)
+    batch = shard_batch(batch, mesh)
+    with jax.set_mesh(mesh):
+        return programs.filter_and_score(cluster, batch, cfg,
+                                         host_ok=_shard_host_ok(host_ok,
+                                                                mesh))
+
+
+def _shard_host_ok(host_ok, mesh: Mesh):
+    if host_ok is None:
+        return None
+    host_ok = np.asarray(host_ok)
+    ok = (host_ok.shape[0] % mesh.shape[AXIS_PODS] == 0
+          and host_ok.shape[1] % mesh.shape[AXIS_NODES] == 0)
+    spec = P(AXIS_PODS, AXIS_NODES) if ok else P()
+    return _put(host_ok, NamedSharding(mesh, spec))
+
+
 def sharded_schedule_gang(cluster, batch, cfg: programs.ProgramConfig, rng,
                           mesh: Mesh, shard_existing_pods: bool = True,
-                          max_rounds: Optional[int] = None):
+                          max_rounds: Optional[int] = None,
+                          host_ok=None, intra_batch_topology: bool = True):
     """Gang auction over the mesh.  The [B, N] filter/score work shards over
     both axes; the admission sort + segmented prefix-sums are [B]-sized (a
     few MB even at 100k pods), which XLA gathers as needed — the per-round
     collectives replace the serial loop's cross-pod carries."""
     cluster = shard_cluster(cluster, mesh, shard_existing_pods)
     batch = shard_batch(batch, mesh)
-    rng = jax.device_put(rng, NamedSharding(mesh, P()))
+    rng = _put(rng, NamedSharding(mesh, P()))
     with jax.set_mesh(mesh):
         return gang.schedule_gang(cluster, batch, cfg, rng,
-                                  max_rounds=max_rounds)
+                                  host_ok=_shard_host_ok(host_ok, mesh),
+                                  max_rounds=max_rounds,
+                                  intra_batch_topology=intra_batch_topology)
 
 
 def sharded_schedule_sequential(cluster, batch, cfg: programs.ProgramConfig,
                                 rng, mesh: Mesh,
-                                shard_existing_pods: bool = True):
+                                shard_existing_pods: bool = True,
+                                hard_pod_affinity_weight: float = 1.0,
+                                host_ok=None, start_index=0):
     """Sequential-replay scan over the mesh: the scan axis (pods, in order)
     is serial by construction; each step's per-node work shards over
     "nodes" and the precomputed O(B×P×N) matmuls shard over both axes."""
     cluster = shard_cluster(cluster, mesh, shard_existing_pods)
     batch = shard_batch(batch, mesh)
-    rng = jax.device_put(rng, NamedSharding(mesh, P()))
+    rng = _put(rng, NamedSharding(mesh, P()))
     with jax.set_mesh(mesh):
-        return sequential.schedule_sequential(cluster, batch, cfg, rng)
+        return sequential.schedule_sequential(
+            cluster, batch, cfg, rng,
+            hard_pod_affinity_weight=hard_pod_affinity_weight,
+            host_ok=_shard_host_ok(host_ok, mesh),
+            start_index=start_index)

@@ -94,6 +94,14 @@ class Scheduler:
         # rotating node-search start (reference: nextStartNodeIndex,
         # generic_scheduler.go:451); persists across cycles
         self._next_start_node_index = 0
+        # device mesh for the serving path: mesh_shape=(pods, nodes) runs
+        # every cycle's program through parallel/mesh.py sharding (the
+        # reference's 16-goroutine parallelizer runs on every cycle,
+        # internal/parallelize/parallelism.go:26-43); None = single device
+        self._mesh = None
+        if self.config.mesh_shape:
+            from .parallel import mesh as pmesh
+            self._mesh = pmesh.make_mesh(tuple(self.config.mesh_shape))
         self._jax = jax
         self._async_binding = async_binding
         self._bind_pool = ThreadPoolExecutor(max_workers=16,
@@ -369,20 +377,40 @@ class Scheduler:
                           # DefaultPodTopologySpread even without explicit
                           # terms — they need intra-batch placements too
                           or any(s is not None for s in spread_sels))
-            res = schedule_gang(
-                cluster, batch, cfg, self._next_rng(),
-                host_ok=self._jax.numpy.asarray(host_ok) if any_host else None,
-                intra_batch_topology=needs_topo)
+            if self._mesh is not None:
+                from .parallel import mesh as pmesh
+                res = pmesh.sharded_schedule_gang(
+                    cluster, batch, cfg, self._next_rng(), self._mesh,
+                    host_ok=host_ok if any_host else None,
+                    intra_batch_topology=needs_topo)
+            else:
+                res = schedule_gang(
+                    cluster, batch, cfg, self._next_rng(),
+                    host_ok=self._jax.numpy.asarray(host_ok) if any_host
+                    else None,
+                    intra_batch_topology=needs_topo)
             # the auction already produced per-pod verdict rows; share them
             # so preemption skips its candidates pass entirely
             cycle_ctx.feasible = np.asarray(res.feasible0)
             cycle_ctx.unresolvable = np.asarray(res.unresolvable)
         else:
-            res = schedule_sequential(
-                cluster, batch, cfg, self._next_rng(),
-                hard_pod_affinity_weight=float(fwk.hard_pod_affinity_weight),
-                host_ok=self._jax.numpy.asarray(host_ok) if any_host else None,
-                start_index=self._next_start_node_index % max(n_nodes, 1))
+            start = self._next_start_node_index % max(n_nodes, 1)
+            if self._mesh is not None:
+                from .parallel import mesh as pmesh
+                res = pmesh.sharded_schedule_sequential(
+                    cluster, batch, cfg, self._next_rng(), self._mesh,
+                    hard_pod_affinity_weight=float(
+                        fwk.hard_pod_affinity_weight),
+                    host_ok=host_ok if any_host else None,
+                    start_index=start)
+            else:
+                res = schedule_sequential(
+                    cluster, batch, cfg, self._next_rng(),
+                    hard_pod_affinity_weight=float(
+                        fwk.hard_pod_affinity_weight),
+                    host_ok=self._jax.numpy.asarray(host_ok) if any_host
+                    else None,
+                    start_index=start)
             self._next_start_node_index = int(res.next_start)
         chosen = np.asarray(res.chosen)[:len(live)]
         n_feas = np.asarray(res.n_feasible)[:len(live)]
@@ -420,9 +448,15 @@ class Scheduler:
         refine feasibility/scores and selection happens host-side."""
         from .extender import MAX_EXTENDER_PRIORITY, ExtenderError
         import random
-        res = programs.filter_and_score(
-            cluster, batch, cfg,
-            self._jax.numpy.asarray(host_ok) if host_ok is not None else None)
+        if self._mesh is not None:
+            from .parallel import mesh as pmesh
+            res = pmesh.sharded_filter_and_score(cluster, batch, cfg,
+                                                 self._mesh, host_ok=host_ok)
+        else:
+            res = programs.filter_and_score(
+                cluster, batch, cfg,
+                self._jax.numpy.asarray(host_ok) if host_ok is not None
+                else None)
         feasible = np.asarray(res.feasible)
         scores = np.asarray(res.scores)
         n_nodes = len(node_infos)

@@ -68,3 +68,11 @@ def test_dryrun_body_refuses_unpinned_env():
         capture_output=True, text=True, timeout=120)
     assert proc.returncode != 0
     assert "JAX_PLATFORMS=cpu" in proc.stderr
+
+
+def test_dryrun_multihost_two_processes():
+    """DCN shape: two jax.distributed processes x 2 virtual CPU chips form
+    one global mesh and execute the sharded programs (the multi-host
+    analog of the reference's multi-node comm backend)."""
+    import __graft_entry__ as graft
+    graft.dryrun_multihost(2, 2)

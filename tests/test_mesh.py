@@ -10,6 +10,7 @@ import numpy as np
 import pytest
 
 import __graft_entry__ as graft
+from kubetpu.api import types as api
 from kubetpu.models import programs
 from kubetpu.models.gang import schedule_gang
 from kubetpu.models.sequential import schedule_sequential
@@ -65,3 +66,43 @@ def test_sharded_sequential_matches_single_device():
     np.testing.assert_array_equal(np.asarray(ref.chosen), np.asarray(res.chosen))
     np.testing.assert_allclose(np.asarray(ref.requested),
                                np.asarray(res.requested), rtol=0, atol=0)
+
+
+def _serve_outcomes(mesh_shape, mode, seed=7):
+    """One scheduling cycle through the REAL serving path with the given
+    mesh shape (None = single device); returns {pod name: node}."""
+    from kubetpu.apis.config import (KubeSchedulerConfiguration,
+                                     KubeSchedulerProfile)
+    from kubetpu.client.store import ClusterStore
+    from kubetpu.harness import hollow
+    from kubetpu.scheduler import Scheduler
+
+    store = ClusterStore()
+    for n in hollow.make_nodes(16, zones=4):
+        store.add(n)
+    pods = hollow.make_pods(24, group_labels=4)
+    for i, p in enumerate(pods):
+        if i % 3 == 0:
+            hollow.with_spread(p, api.LABEL_ZONE, when="ScheduleAnyway")
+        if i % 5 == 0:
+            hollow.with_anti_affinity(p, api.LABEL_HOSTNAME)
+        store.add(p)
+    cfg = KubeSchedulerConfiguration(profiles=[KubeSchedulerProfile()],
+                                     batch_size=32, mode=mode,
+                                     mesh_shape=mesh_shape)
+    sched = Scheduler(store, config=cfg, seed=seed, async_binding=False)
+    out = sched.schedule_pending(timeout=0.0)
+    sched.close()
+    return {o.pod.metadata.name: o.node for o in out}
+
+
+def test_serving_path_mesh_matches_single_device():
+    """Scheduler honors mesh_shape: a (1,8) node-sharded and a (2,4) 2D
+    mesh must produce EXACTLY the placements of the single-device run, in
+    both execution modes (the mesh is a performance knob, never a
+    semantics knob)."""
+    for mode in ("sequential", "gang"):
+        want = _serve_outcomes(None, mode)
+        assert any(want.values())
+        assert _serve_outcomes((1, 8), mode) == want
+        assert _serve_outcomes((2, 4), mode) == want
