@@ -28,7 +28,8 @@ Handler = Callable[[str, Optional[object], Optional[object]], None]
 
 KINDS = ("Pod", "Node", "PersistentVolumeClaim", "PersistentVolume",
          "StorageClass", "CSINode", "Service", "ReplicaSet",
-         "ReplicationController", "StatefulSet", "PodDisruptionBudget")
+         "ReplicationController", "StatefulSet", "PodDisruptionBudget",
+         "Event")
 
 
 class Conflict(Exception):
@@ -54,7 +55,8 @@ class ClusterStore:
         m = obj.metadata
         return f"{m.namespace}/{m.name}" if getattr(obj, "kind", "") in (
             "Pod", "PersistentVolumeClaim", "Service", "ReplicaSet",
-            "ReplicationController", "StatefulSet", "PodDisruptionBudget") \
+            "ReplicationController", "StatefulSet", "PodDisruptionBudget",
+            "Event") \
             else m.name
 
     def subscribe(self, kind: str, handler: Handler) -> None:

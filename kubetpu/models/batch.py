@@ -163,6 +163,43 @@ def densify_for(cluster, batch: "PodBatch") -> "PodBatch":
     return batch._replace(kv_hot=kv_hot, key_hot=key_hot)
 
 
+def gather_batch_rows(batch: "PodBatch", rows: np.ndarray) -> "PodBatch":
+    """Select pod rows (numpy; -1 entries are padding -> valid False).
+    The residual-auction host loop uses this to re-run only the CONTENDED
+    pods of a batch.  Selector sets gather by slot index — the unique
+    compiled tensors are shared, so this is O(rows), not O(vocab)."""
+    B = batch.valid.shape[0]
+    U = rows.shape[0]
+    safe = np.clip(rows, 0, B - 1)
+    live = rows >= 0
+
+    def arr(x):
+        if x is None:
+            return None
+        x = np.asarray(x)
+        if x.ndim >= 1 and x.shape[0] == B:          # [B, ...]
+            return x[safe]
+        if x.ndim >= 1 and x.shape[0] % B == 0:      # flat [B*T, ...]
+            t = x.shape[0] // B
+            return x.reshape((B, t) + x.shape[1:])[safe].reshape(
+                (U * t,) + x.shape[1:])
+        return x
+
+    def sel(s: SelectorSet) -> SelectorSet:
+        return s._replace(index=arr(np.asarray(s.index)))
+
+    def walk(v):
+        if isinstance(v, SelectorSet):
+            return sel(v)
+        if isinstance(v, (PodTerms, SpreadConstraints)):
+            return type(v)(*[walk(f) for f in v])
+        return arr(v)
+
+    out = PodBatch(*[walk(f) for f in batch])
+    return out._replace(valid=np.asarray(out.valid) & live,
+                        kv_hot=None, key_hot=None)
+
+
 class PodBatchBuilder:
     def __init__(self, table: InternTable):
         self.table = table

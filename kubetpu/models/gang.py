@@ -80,6 +80,8 @@ class GangResult(NamedTuple):
     score: jnp.ndarray      # [B] f32 score of the winning node at admission
     rounds: jnp.ndarray     # i32 number of propose/admit rounds executed
     requested: jnp.ndarray  # [N, R] final requested incl. batch placements
+    nz: jnp.ndarray         # [N, 2] final non-zero requested
+    ports_used: jnp.ndarray  # [N, P] f32 ports registered by batch placements
     feasible0: jnp.ndarray  # [B, N] bool first-round feasibility (diagnostics)
     unresolvable: jnp.ndarray  # [B, N] bool — static filters plus the
                             # InterPodAffinity required-affinity bits
@@ -153,13 +155,96 @@ def _key_terms_mask(terms, k: int) -> jnp.ndarray:
     return (terms.topo_key == k) & terms.valid & terms.topo_known
 
 
+@jax.jit
+def _materialize_assigned(cluster, batch, chosen, requested, nz, ports_used):
+    """Fold a (partial) auction's placements into the cluster: assigned
+    batch pods join the existing-pod axis at their nodes, their committed
+    usage replaces requested/nonzero, and their registered hostPorts join
+    cluster.ports — the input state for a RESIDUAL auction over the pods
+    that lost the first round."""
+    from .batch import densify_for
+    batch = densify_for(cluster, batch)
+    ext = _extend_cluster(cluster, batch)
+    assigned = (chosen >= 0) & batch.valid
+    return ext._replace(
+        pod_node=jnp.concatenate([cluster.pod_node, chosen]),
+        pod_valid=jnp.concatenate([cluster.pod_valid, assigned]),
+        requested=requested,
+        nonzero_requested=nz,
+        ports=cluster.ports | (ports_used > 0.5),
+    )
+
+
+def run_auction(cluster, batch, cfg: ProgramConfig, rng,
+                host_ok=None, intra_batch_topology: bool = True,
+                min_bucket: int = 16) -> GangResult:
+    """Two-phase gang auction (HOST orchestrator, not jitted).
+
+    Phase 1 runs ONE full-batch propose/admit round — the uncontended
+    majority admits here.  Phase 2 re-auctions only the losers: their rows
+    gather into a pow2 bucket (gather_batch_rows) against the cluster with
+    phase 1's placements materialized, so the expensive per-round
+    filter+score work is sized by the CONTENDED pod count, not B.  The
+    monolithic while_loop (schedule_gang) pays ~B-sized work every round
+    by static-shape necessity; this wrapper is the throughput path the
+    serving loop uses.  Residual pods keep their ORIGINAL tie-break
+    stream ids and admission order, and phase 1's placements are
+    materialized exactly as the loop's carry would see them, so the
+    two-phase result replays the monolithic loop's placements."""
+    import numpy as np
+    from .batch import gather_batch_rows
+    from ..utils.intern import pow2_bucket
+
+    B = np.asarray(batch.valid).shape[0]
+    res0 = schedule_gang(cluster, batch, cfg, rng, host_ok=host_ok,
+                         max_rounds=1,
+                         intra_batch_topology=intra_batch_topology)
+    chosen0 = np.asarray(res0.chosen)
+    valid = np.asarray(batch.valid)
+    rows = np.nonzero((chosen0 < 0) & valid)[0]
+    if rows.size == 0:
+        return res0
+    if rows.size > B // 2:
+        # heavily contended: the monolithic loop does no redundant work
+        return schedule_gang(cluster, batch, cfg, rng, host_ok=host_ok,
+                             intra_batch_topology=intra_batch_topology)
+    U = pow2_bucket(rows.size, min_bucket)
+    pad = np.full((U,), -1, np.int64)
+    pad[:rows.size] = rows
+    sub = gather_batch_rows(batch, pad)
+    sub_ok = None
+    if host_ok is not None:
+        sub_ok = jnp.asarray(np.asarray(host_ok)[np.clip(pad, 0, B - 1)])
+    ext = _materialize_assigned(cluster, batch, res0.chosen, res0.requested,
+                                res0.nz, res0.ports_used)
+    res1 = schedule_gang(ext, sub, cfg, rng, host_ok=sub_ok,
+                         intra_batch_topology=intra_batch_topology,
+                         tie_index=jnp.asarray(np.clip(pad, 0, B - 1),
+                                               jnp.int32))
+    chosen1 = np.asarray(res1.chosen)[:rows.size]
+    score1 = np.asarray(res1.score)[:rows.size]
+    chosen = chosen0.copy()
+    chosen[rows] = chosen1
+    score = np.asarray(res0.score).copy()
+    score[rows] = score1
+    return GangResult(
+        chosen=chosen, score=score,
+        rounds=res0.rounds + res1.rounds,
+        requested=res1.requested, nz=res1.nz,
+        ports_used=jnp.maximum(res0.ports_used, res1.ports_used),
+        feasible0=res0.feasible0, unresolvable=res0.unresolvable,
+        n_feasible=res0.n_feasible,
+        all_unresolvable=res0.all_unresolvable)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("cfg", "max_rounds",
                                     "intra_batch_topology"))
 def schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
                   host_ok: Optional[jnp.ndarray] = None,
                   max_rounds: Optional[int] = None,
-                  intra_batch_topology: bool = True) -> GangResult:
+                  intra_batch_topology: bool = True,
+                  tie_index: Optional[jnp.ndarray] = None) -> GangResult:
     from .batch import densify_for
     batch = densify_for(cluster, batch)
     B = batch.req.shape[0]
@@ -227,7 +312,11 @@ def schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
         sph_uidx = jnp.asarray(batch.spread.sel.index).reshape(
             B, batch.spread.valid.shape[1])
 
-    pod_idx = jnp.arange(B, dtype=jnp.int32)
+    # tie_index: each pod's selectHost RNG stream id (fold_in index).  The
+    # residual auction passes the pods' ORIGINAL batch rows here so its
+    # draws replay the monolithic loop's exactly.
+    pod_idx = (jnp.arange(B, dtype=jnp.int32) if tie_index is None
+               else jnp.asarray(tie_index, jnp.int32))
     tie_keys = jax.vmap(lambda i: jax.random.fold_in(rng, i))(pod_idx)
 
     P = batch.ports_hot.shape[1]
@@ -424,6 +513,7 @@ def schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
     all_unres = jnp.all(unresolvable | out["feas0"] | ~base, axis=1)
     return GangResult(chosen=out["assigned"], score=out["win_score"],
                       rounds=out["rounds"], requested=out["req"],
+                      nz=out["nz"], ports_used=out["ports_used"],
                       feasible0=out["feas0"], unresolvable=unresolvable,
                       n_feasible=jnp.sum(out["feas0"].astype(jnp.int32),
                                          axis=1),

@@ -226,6 +226,17 @@ def run_scores(cluster, batch, cfg: ProgramConfig, feasible, affinity_ok,
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
+def filter_verdicts(cluster, batch, cfg: ProgramConfig, host_ok=None):
+    """Filters only — (feasible, unresolvable).  Preemption's shared
+    verdict refresh uses this; computing scores there would be pure
+    waste."""
+    from .batch import densify_for
+    batch = densify_for(cluster, batch)
+    feasible, unresolvable, _ = run_filters(cluster, batch, cfg, host_ok)
+    return feasible, unresolvable
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
 def filter_and_score(cluster, batch, cfg: ProgramConfig,
                      host_ok=None) -> FilterScoreResult:
     from .batch import densify_for

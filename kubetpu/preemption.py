@@ -83,6 +83,14 @@ class CycleContext:
         self.commits = 0
         self._verdict_commits = 0
         self._cluster_cache = None   # (commits, overlaid cluster)
+        self._lazy = None            # (feasible_dev, unresolvable_dev)
+
+    def set_lazy_verdicts(self, feasible_dev, unresolvable_dev) -> None:
+        """Share DEVICE verdict arrays without forcing a transfer: they
+        materialize only if a preemption attempt actually reads them with
+        no commits in between (otherwise a refresh supersedes them and the
+        multi-MB device->host copy never happens)."""
+        self._lazy = (feasible_dev, unresolvable_dev)
 
     def note_commit(self, row: int, node_row: int) -> None:
         """Record a committed batch placement (batch row -> node row)."""
@@ -133,17 +141,55 @@ class CycleContext:
         row = self.row_of.get(pod_uid)
         if row is None:
             return None
+        self._materialize_lazy()
         if self.feasible is not None and self._verdict_commits != self.commits:
             return None
         if self.feasible is None:
             if self.batch is None:
                 return None
-            res = programs.filter_and_score(self.cluster_now(), self.batch,
-                                            self.cfg)
-            self.feasible = np.asarray(res.feasible)
-            self.unresolvable = np.asarray(res.unresolvable)
-            self._verdict_commits = self.commits
+            self.refresh_verdicts()
         return self.feasible[row], self.unresolvable[row]
+
+    def _materialize_lazy(self) -> None:
+        """Pull the auction's device verdict arrays to host IF they are
+        still current (no commits since) and nothing fresher exists."""
+        if self.feasible is None and self._lazy is not None \
+                and self.commits == 0:
+            self.feasible = np.asarray(self._lazy[0])
+            self.unresolvable = np.asarray(self._lazy[1])
+
+    def refresh_verdicts(self) -> None:
+        """One whole-batch filter pass against the CURRENT committed state,
+        shared by every preemption attempt that follows.  The scheduler
+        calls this once after the commit loop so N failed pods cost one
+        [B, N] pass, not N single-pod passes."""
+        feasible, unresolvable = programs.filter_verdicts(
+            self.cluster_now(), self.batch, self.cfg)
+        self.feasible = np.asarray(feasible)
+        self.unresolvable = np.asarray(unresolvable)
+        self._verdict_commits = self.commits
+
+    def min_pod_priority(self):
+        """Lowest priority among all existing pods (lazily computed once
+        per cycle), or None when the cluster has no pods.  A preemptor
+        whose priority is <= this can never find a victim, so preemption
+        short-circuits without any device pass (the reference reaches the
+        same conclusion inside selectVictimsOnNode, one candidate at a
+        time)."""
+        if not hasattr(self, "_min_prio"):
+            prios = [pi.pod.priority() for ni in self.node_infos
+                     for pi in ni.pods]
+            self._min_prio = min(prios) if prios else None
+        return self._min_prio
+
+    def ensure_fresh(self) -> None:
+        """Refresh the shared verdicts if any commit landed since they were
+        taken (no-op when they are already current)."""
+        if self.batch is None:
+            return
+        self._materialize_lazy()
+        if self.feasible is None or self._verdict_commits != self.commits:
+            self.refresh_verdicts()
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
@@ -228,6 +274,11 @@ class Preemptor:
             cycle = self._build_cycle(fwk, pod)
         node_infos = cycle.node_infos
         if not node_infos:
+            return None
+        min_prio = cycle.min_pod_priority()
+        if min_prio is None or pod.priority() <= min_prio:
+            # nothing anywhere is evictable by this pod — skip the whole
+            # candidates/what-if machinery
             return None
 
         cand = self._nodes_where_preemption_might_help(fwk, pod, cycle)
@@ -348,10 +399,10 @@ class Preemptor:
         verdicts = cycle.pod_verdicts(pod.uid)
         if verdicts is None:
             batch1 = self._pod_batch1(pod, cycle)
-            res = programs.filter_and_score(cycle.cluster_now(), batch1,
-                                            cycle.cfg)
-            feasible = np.asarray(res.feasible)[0]
-            unresolvable = np.asarray(res.unresolvable)[0]
+            feas1, unres1 = programs.filter_verdicts(cycle.cluster_now(),
+                                                     batch1, cycle.cfg)
+            feasible = np.asarray(feas1)[0]
+            unresolvable = np.asarray(unres1)[0]
         else:
             feasible, unresolvable = verdicts
         feasible = np.array(feasible[:len(node_infos)])

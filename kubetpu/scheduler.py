@@ -63,6 +63,10 @@ class Scheduler:
                  config: Optional[KubeSchedulerConfiguration] = None,
                  registry=None, seed: int = 0, async_binding: bool = True,
                  metrics=None, recorder=None):
+        # warm restarts must not recompile byte-identical programs — the
+        # persistent cache is a serving default, not a bench trick
+        from .utils.compilation import enable_persistent_cache
+        enable_persistent_cache()
         import jax
         self.store = store
         self.config = config or KubeSchedulerConfiguration(
@@ -70,7 +74,13 @@ class Scheduler:
         if not self.config.profiles:
             self.config.profiles = [KubeSchedulerProfile()]
         self.metrics = metrics
-        self.recorder = recorder
+        if recorder is None:
+            # reference: profile/profile.go:33 NewRecorderFactory — every
+            # profile gets a real recorder; the store plays the event sink
+            from .utils.events import EventBroadcaster
+            self.broadcaster = EventBroadcaster(sink=store)
+            recorder = self.broadcaster.new_recorder()
+        self.recorder = recorder or None
         self.cache = SchedulerCache()
         registry = registry or new_in_tree_registry()
 
@@ -366,7 +376,6 @@ class Scheduler:
         # ---- device: one program for the whole group (scan or auction)
         if self.config.mode == "gang":
             from .framework.types import pod_with_affinity
-            from .models.gang import schedule_gang
             # per-round topology re-evaluation only pays off when some pod
             # actually carries topology terms; a term-free batch takes the
             # cheaper static path (round-0 verdicts are provably invariant)
@@ -384,15 +393,16 @@ class Scheduler:
                     host_ok=host_ok if any_host else None,
                     intra_batch_topology=needs_topo)
             else:
-                res = schedule_gang(
+                from .models.gang import run_auction
+                res = run_auction(
                     cluster, batch, cfg, self._next_rng(),
                     host_ok=self._jax.numpy.asarray(host_ok) if any_host
                     else None,
                     intra_batch_topology=needs_topo)
             # the auction already produced per-pod verdict rows; share them
-            # so preemption skips its candidates pass entirely
-            cycle_ctx.feasible = np.asarray(res.feasible0)
-            cycle_ctx.unresolvable = np.asarray(res.unresolvable)
+            # lazily so preemption can skip its candidates pass without the
+            # scheduler paying a multi-MB transfer it may never need
+            cycle_ctx.set_lazy_verdicts(res.feasible0, res.unresolvable)
         else:
             start = self._next_start_node_index % max(n_nodes, 1)
             if self._mesh is not None:
@@ -417,15 +427,18 @@ class Scheduler:
         unres = np.asarray(res.all_unresolvable)[:len(live)]
         trace.step("Computing predicates and priorities on device done")
 
-        # ---- commit each placement in scan order
+        # ---- commit each placement in scan order; failures DEFER until
+        # every commit has landed so all preemption attempts share one
+        # verdict refresh against the final committed state (N failed pods
+        # cost one [B, N] pass, not N)
+        deferred = []  # (outcome index, qp, state, message, may_help)
         for i, qp in enumerate(live):
             state = states[qp.pod.uid]
             if chosen[i] < 0:
-                outcomes.append(self._fail(
-                    fwk, qp, state, "",
-                    f"0/{n_nodes} nodes are available",
-                    preemption_may_help=not bool(unres[i]),
-                    cycle=cycle_ctx))
+                outcomes.append(None)
+                deferred.append((len(outcomes) - 1, qp, state,
+                                 f"0/{n_nodes} nodes are available",
+                                 not bool(unres[i])))
                 continue
             node_name = node_infos[int(chosen[i])].node_name
             outcome = self._commit(fwk, qp, state, node_name,
@@ -435,6 +448,13 @@ class Scheduler:
                 # this placement (CycleContext.cluster_now overlay)
                 cycle_ctx.note_commit(i, int(chosen[i]))
             outcomes.append(outcome)
+        # pod_verdicts refreshes the shared verdicts lazily on the FIRST
+        # preemption attempt that needs them (and the min-priority gate may
+        # skip them entirely), so no eager refresh here
+        for idx, qp, state, msg, mh in deferred:
+            outcomes[idx] = self._fail(fwk, qp, state, "", msg,
+                                       preemption_may_help=mh,
+                                       cycle=cycle_ctx)
         trace.step("Committing placements done")
         trace.log_if_long()
         return outcomes
@@ -607,8 +627,12 @@ class Scheduler:
             return self._fail(fwk, qp, state, node_name, st.message(),
                               preemption_may_help=False)
 
-        # assume (reference: scheduler.go:435,593)
-        assumed = copy.deepcopy(pod)
+        # assume (reference: scheduler.go:435,593).  A shallow clone with a
+        # fresh spec is enough: the cache reads spec/containers/labels,
+        # which the scheduler never mutates — the deep copy burned ~1.5s
+        # per 4k-pod cycle for nothing.
+        assumed = copy.copy(pod)
+        assumed.spec = copy.copy(pod.spec)
         assumed.spec.node_name = node_name
         try:
             self.cache.assume_pod(assumed)

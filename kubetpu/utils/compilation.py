@@ -26,8 +26,13 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str:
         return _enabled
     cache_dir = cache_dir or os.environ.get("KUBETPU_XLA_CACHE_DIR",
                                             DEFAULT_CACHE_DIR)
-    os.makedirs(cache_dir, exist_ok=True)
     import jax
+    existing = getattr(jax.config, "jax_compilation_cache_dir", None)
+    if existing:
+        # the embedding application already configured a cache — respect it
+        _enabled = existing
+        return existing
+    os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     # cache every program: even sub-second kernels add up across restarts
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)

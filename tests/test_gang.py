@@ -301,3 +301,29 @@ def test_self_affinity_gang_converges_in_few_rounds():
     assert len(zones) == 1, chosen
     # bootstrap defers only round 1; everything else co-admits
     assert int(g.rounds) <= 4, int(g.rounds)
+
+
+def test_run_auction_replays_monolithic_loop():
+    # The two-phase residual auction must reproduce the monolithic
+    # while_loop's placements bit-for-bit on a contended topology workload
+    # (same tie-break streams, same admission order, same committed state).
+    from kubetpu.harness import hollow
+    nodes = [mknode(name=f"n{i}", labels={
+        api.LABEL_HOSTNAME: f"n{i}", api.LABEL_ZONE: f"z{i % 2}"})
+        for i in range(6)]
+    pending = []
+    for i in range(18):
+        p = mkpod(name=f"p{i}", labels={"app": f"g{i % 3}"})
+        if i % 2 == 0:
+            hollow.with_anti_affinity(p, api.LABEL_HOSTNAME)
+        if i % 3 == 0:
+            hollow.with_spread(p, api.LABEL_ZONE, when="DoNotSchedule")
+        pending.append(p)
+    cluster, batch, cfg, _ = build(nodes, {}, pending, filters=TOPO_FILTERS)
+    rng = jax.random.PRNGKey(3)
+    mono = gang.schedule_gang(cluster, batch, cfg, rng)
+    two = gang.run_auction(cluster, batch, cfg, rng)
+    assert np.array_equal(np.asarray(two.chosen)[:18],
+                          np.asarray(mono.chosen)[:18])
+    assert np.allclose(np.asarray(two.requested),
+                       np.asarray(mono.requested))

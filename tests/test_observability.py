@@ -113,3 +113,45 @@ def test_cache_dumper():
     store.add(p)
     out = CacheDumper(sched.cache, sched.queue).dump()
     assert "n1" in out and "'p'" in out
+
+
+def test_event_broadcaster_aggregates_and_sinks():
+    """reference: client-go tools/events — repeats inside the aggregation
+    window bump count on ONE Event object; distinct reasons make new
+    objects; the scheduler records Scheduled events by default."""
+    from kubetpu.client.store import ClusterStore
+    from kubetpu.harness import hollow
+    from kubetpu.scheduler import Scheduler
+    from kubetpu.utils.events import EventBroadcaster
+
+    now = [1000.0]
+    store = ClusterStore()
+    b = EventBroadcaster(sink=store, clock=lambda: now[0])
+    rec = b.new_recorder("test")
+    pod = hollow.make_pod("p1")
+    rec.event(pod, "Warning", "FailedScheduling", "0/3 nodes")
+    rec.event(pod, "Warning", "FailedScheduling", "0/3 nodes again")
+    now[0] += 5
+    rec.event(pod, "Warning", "FailedScheduling", "still failing")
+    evs = store.list("Event")
+    assert len(evs) == 1
+    assert evs[0].count == 3
+    assert evs[0].message == "still failing"
+    rec.event(pod, "Normal", "Scheduled", "bound")
+    assert len(store.list("Event")) == 2
+    # outside the window -> a fresh Event object
+    now[0] += 700
+    rec.event(pod, "Warning", "FailedScheduling", "later")
+    assert len([e for e in store.list("Event")
+                if e.reason == "FailedScheduling"]) == 2
+
+    # the serving path records by default
+    store2 = ClusterStore()
+    store2.add(hollow.make_node("n1"))
+    sched = Scheduler(store2, async_binding=False)
+    store2.add(hollow.make_pod("p"))
+    out = sched.schedule_pending(timeout=0.0)
+    assert out[0].err is None
+    evs = store2.list("Event")
+    assert any(e.reason == "Scheduled" for e in evs)
+    sched.close()
