@@ -105,7 +105,8 @@ def run_mode(mode, n_nodes, n_pods, existing_per_node, repeats,
             cfg = KubeSchedulerConfiguration(
                 profiles=[KubeSchedulerProfile()],
                 batch_size=min(n_pods, batch_cap), mode=mode,
-                mesh_shape=mesh_shape)
+                mesh_shape=mesh_shape,
+                chain_cycles=os.environ.get("BENCH_CHAIN", "1") != "0")
             sched = Scheduler(store, config=cfg, async_binding=False)
             for p in pending:
                 store.add(p)

@@ -103,6 +103,16 @@ class KubeSchedulerConfiguration:
     # snapshot rather than intra-batch placements.
     mode: str = "sequential"
     mesh_shape: Optional[tuple] = None
+    # EXPERIMENTAL cycle chaining (gang mode): reuse the auction's
+    # materialized cluster as the next cycle's snapshot tensors instead of
+    # re-tensorizing.  Currently engages only while the pod axis is
+    # crossing pow2 buckets (fast drains): a stable pod count fails the
+    # bucket guard because materialization appends rather than reusing
+    # slack rows, and each chained cycle's grown unique-selector axis
+    # costs an XLA recompile.  Off by default until slack-reuse
+    # materialization lands; the delta-update plumbing (dirty tracking,
+    # pod-row registry, materialize padding) is in place and tested.
+    chain_cycles: bool = False
 
     def profile_for(self, name: str) -> Optional[KubeSchedulerProfile]:
         for p in self.profiles:

@@ -155,24 +155,89 @@ def _key_terms_mask(terms, k: int) -> jnp.ndarray:
     return (terms.topo_key == k) & terms.valid & terms.topo_known
 
 
-@jax.jit
-def _materialize_assigned(cluster, batch, chosen, requested, nz, ports_used):
+@functools.partial(jax.jit, static_argnames=("pad_pods_to", "pad_terms_to",
+                                             "extend_score_terms"))
+def materialize_assigned(cluster, batch, chosen, requested, nz, ports_used,
+                         pad_pods_to: int = 0, pad_terms_to: int = 0,
+                         extend_score_terms: bool = False,
+                         hard_pod_affinity_weight: float = 1.0):
     """Fold a (partial) auction's placements into the cluster: assigned
     batch pods join the existing-pod axis at their nodes, their committed
     usage replaces requested/nonzero, and their registered hostPorts join
-    cluster.ports — the input state for a RESIDUAL auction over the pods
-    that lost the first round."""
+    cluster.ports.  Two consumers: the RESIDUAL auction over the pods
+    that lost round one, and CYCLE CHAINING — the serving loop reuses this
+    as the next cycle's cluster instead of re-tensorizing the world
+    (SURVEY §7 delta-updates; pad_pods_to/pad_terms_to pow2-pad the grown
+    axes so successive cycles hit the same compiled programs)."""
     from .batch import densify_for
+    from ..ops.selectors import pad_selector_slots
     batch = densify_for(cluster, batch)
     ext = _extend_cluster(cluster, batch)
     assigned = (chosen >= 0) & batch.valid
-    return ext._replace(
+    ext = ext._replace(
         pod_node=jnp.concatenate([cluster.pod_node, chosen]),
         pod_valid=jnp.concatenate([cluster.pod_valid, assigned]),
         requested=requested,
         nonzero_requested=nz,
         ports=cluster.ports | (ports_used > 0.5),
     )
+    if extend_score_terms:
+        # a FRESH rebuild would put the newly-bound pods' preferred terms
+        # (signed weights) and required-affinity terms (hardPodAffinityWeight)
+        # into score_terms (state/tensors.py:334); chained clusters must
+        # match or scoring silently diverges from a rebuild
+        P0 = cluster.pod_valid.shape[0]
+        TK = cluster.topo_pair.shape[1]
+        st = cluster.score_terms
+
+        def term_rows(t, w):
+            bb, tt = t.valid.shape
+            return (t.sel, t.ns_hot.reshape(bb * tt, -1),
+                    t.topo_key.reshape(-1),
+                    P0 + jnp.repeat(jnp.arange(bb, dtype=jnp.int32), tt),
+                    w.reshape(-1),
+                    (t.valid & t.topo_known & (t.topo_key < TK)).reshape(-1))
+
+        pr = term_rows(batch.pref, batch.pref.weight * _f(batch.pref.valid))
+        ra = term_rows(batch.ra,
+                       jnp.full_like(batch.ra.weight,
+                                     hard_pod_affinity_weight)
+                       * _f(batch.ra.valid))
+        ext = ext._replace(score_terms=ExistingTerms(
+            sel=concat_selector_sets(concat_selector_sets(st.sel, pr[0]),
+                                     ra[0]),
+            ns_hot=jnp.concatenate([st.ns_hot, pr[1], ra[1]]),
+            topo_key=jnp.concatenate([st.topo_key, pr[2], ra[2]]),
+            pod_idx=jnp.concatenate([st.pod_idx, pr[3], ra[3]]),
+            weight=jnp.concatenate([st.weight, pr[4], ra[4]]),
+            valid=jnp.concatenate([st.valid, pr[5], ra[5]])))
+    P = ext.pod_valid.shape[0]
+    if pad_pods_to > P:
+        n = pad_pods_to - P
+
+        def padp(x, fill=0):
+            pad = [(0, n)] + [(0, 0)] * (x.ndim - 1)
+            return jnp.pad(x, pad, constant_values=fill)
+        ext = ext._replace(
+            pod_kv=padp(ext.pod_kv), pod_key=padp(ext.pod_key),
+            pod_ns_hot=padp(ext.pod_ns_hot),
+            pod_node=padp(ext.pod_node, -1),
+            pod_valid=padp(ext.pod_valid),
+            pod_terminating=padp(ext.pod_terminating))
+    ft = ext.filter_terms
+    E = ft.valid.shape[0]
+    if pad_terms_to > E:
+        n = pad_terms_to - E
+
+        def padt(x, fill=0):
+            pad = [(0, n)] + [(0, 0)] * (x.ndim - 1)
+            return jnp.pad(x, pad, constant_values=fill)
+        ext = ext._replace(filter_terms=ft._replace(
+            sel=pad_selector_slots(ft.sel, pad_terms_to),
+            ns_hot=padt(ft.ns_hot), topo_key=padt(ft.topo_key),
+            pod_idx=padt(ft.pod_idx), weight=padt(ft.weight),
+            valid=padt(ft.valid)))
+    return ext
 
 
 def run_auction(cluster, batch, cfg: ProgramConfig, rng,
@@ -215,8 +280,8 @@ def run_auction(cluster, batch, cfg: ProgramConfig, rng,
     sub_ok = None
     if host_ok is not None:
         sub_ok = jnp.asarray(np.asarray(host_ok)[np.clip(pad, 0, B - 1)])
-    ext = _materialize_assigned(cluster, batch, res0.chosen, res0.requested,
-                                res0.nz, res0.ports_used)
+    ext = materialize_assigned(cluster, batch, res0.chosen, res0.requested,
+                               res0.nz, res0.ports_used)
     res1 = schedule_gang(ext, sub, cfg, rng, host_ok=sub_ok,
                          intra_batch_topology=intra_batch_topology,
                          tie_index=jnp.asarray(np.clip(pad, 0, B - 1),

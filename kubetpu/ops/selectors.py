@@ -112,6 +112,17 @@ def match_selectors_unique(sel: SelectorSet,
     return jnp.logical_and(jnp.all(ok, axis=1), sel.sel_valid[:, None])
 
 
+def pad_selector_slots(s: SelectorSet, to: int) -> SelectorSet:
+    """Pad the SLOT axis to `to` entries (index 0, callers mask via their
+    own validity arrays — every consumer ANDs a valid mask over slots)."""
+    idx = jnp.asarray(s.index)
+    n = to - idx.shape[0]
+    if n <= 0:
+        return s
+    return s._replace(index=jnp.concatenate(
+        [idx, jnp.zeros((n,), idx.dtype)]))
+
+
 def concat_selector_sets(a: SelectorSet, b: SelectorSet) -> SelectorSet:
     """Concatenate two SelectorSets compiled against the SAME vocab (same
     InternTable): unique rows are stacked (b's slot indices shifted), and the

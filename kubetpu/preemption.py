@@ -84,6 +84,10 @@ class CycleContext:
         self._verdict_commits = 0
         self._cluster_cache = None   # (commits, overlaid cluster)
         self._lazy = None            # (feasible_dev, unresolvable_dev)
+        self.pod_rows = None         # uid -> existing-pod tensor row (set
+                                     # by the scheduler; required when the
+                                     # cluster is CHAINED and rows no
+                                     # longer follow node_infos order)
 
     def set_lazy_verdicts(self, feasible_dev, unresolvable_dev) -> None:
         """Share DEVICE verdict arrays without forcing a transfer: they
@@ -525,8 +529,11 @@ class Preemptor:
         return out
 
     def _pod_rows(self, cycle: CycleContext) -> Dict[str, int]:
-        """pod uid -> existing-pod tensor row (build order of
-        state/tensors.py SnapshotBuilder.build)."""
+        """pod uid -> existing-pod tensor row.  Chained clusters carry the
+        mapping explicitly (rows diverge from build order); otherwise it is
+        the build order of state/tensors.py SnapshotBuilder.build."""
+        if cycle.pod_rows is not None:
+            return cycle.pod_rows
         rows: Dict[str, int] = {}
         row = 0
         for ni in cycle.node_infos:

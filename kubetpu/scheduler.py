@@ -47,6 +47,14 @@ from .state.tensors import SnapshotBuilder
 from .utils.trace import Trace
 
 
+def _vocab_caps(table):
+    """Snapshot of every vocab's pow2 capacity — chained cycles compare
+    this to detect bucket overflow (tensor shapes would change)."""
+    return tuple((n, getattr(table, n).cap) for n in
+                 ("kv", "key", "ns", "topokey", "rname", "port", "taint",
+                  "image", "avoid"))
+
+
 @dataclass
 class ScheduleOutcome:
     pod: api.Pod
@@ -81,7 +89,8 @@ class Scheduler:
             self.broadcaster = EventBroadcaster(sink=store)
             recorder = self.broadcaster.new_recorder()
         self.recorder = recorder or None
-        self.cache = SchedulerCache()
+        self.cache = SchedulerCache(
+            expire_listener=lambda pod: self._mark_chain_dirty())
         registry = registry or new_in_tree_registry()
 
         # one framework per profile (reference: profile/profile.go:59 Map)
@@ -104,6 +113,18 @@ class Scheduler:
         # rotating node-search start (reference: nextStartNodeIndex,
         # generic_scheduler.go:451); persists across cycles
         self._next_start_node_index = 0
+        # cycle chaining (SURVEY §7 delta updates): in gang mode the
+        # auction's materialized cluster IS the next cycle's snapshot
+        # tensors, so successive drain cycles skip the full re-tensorize.
+        # Any store event the chain does not account for (node changes,
+        # external binds, deletions) marks it dirty -> full rebuild.
+        self._chain = None        # dict(builder, cluster, pod_uids, caps)
+        # monotonic event sequence: handlers bump it BEFORE mutating the
+        # cache, so a chain built from state captured at sequence s is
+        # provably stale whenever the sequence has moved — no
+        # capture-vs-snapshot race window
+        self._chain_seq = 0
+        self._chain_lock = threading.Lock()
         # device mesh for the serving path: mesh_shape=(pods, nodes) runs
         # every cycle's program through parallel/mesh.py sharding (the
         # reference's 16-goroutine parallelizer runs on every cycle,
@@ -145,6 +166,7 @@ class Scheduler:
             pod = new if new is not None else old
             if event == "add":
                 if pod.spec.node_name:
+                    self._mark_chain_dirty()   # external bound add
                     self._add_pod_to_cache(pod)
                 elif self._responsible(pod):
                     self.queue.add(pod)
@@ -153,16 +175,20 @@ class Scheduler:
                 is_assigned = bool(new.spec.node_name)
                 if is_assigned and not was_assigned:
                     # bind confirmed (possibly our own optimistic assume)
+                    if not self.cache.is_assumed_pod(new):
+                        self._mark_chain_dirty()   # a foreign writer bound it
                     self._add_pod_to_cache(new)
                     self.queue.delete(old)
                     self.queue.assigned_pod_added(new)
                 elif is_assigned:
+                    self._mark_chain_dirty()
                     self._update_pod_in_cache(old, new)
                     self.queue.assigned_pod_updated(new)
                 elif self._responsible(new) and not self._skip_pod_update(old, new):
                     self.queue.update(old, new)
             elif event == "delete":
                 if pod.spec.node_name:
+                    self._mark_chain_dirty()
                     try:
                         self.cache.remove_pod(pod)
                     except ValueError:
@@ -175,6 +201,7 @@ class Scheduler:
                         fwk.reject_waiting_pod(pod.uid)
 
         def on_node(event: str, old, new) -> None:
+            self._mark_chain_dirty()
             if event == "add":
                 self.cache.add_node(new)
                 self.queue.move_all_to_active_or_backoff_queue("NodeAdd")
@@ -198,6 +225,12 @@ class Scheduler:
         for kind in ("PersistentVolume", "PersistentVolumeClaim",
                      "StorageClass", "Service", "CSINode"):
             s.subscribe(kind, on_moveable(kind))
+
+    def _mark_chain_dirty(self) -> None:
+        """Bump the chain event sequence (BEFORE the cache mutation it
+        describes, so a concurrent capture can never miss it)."""
+        with self._chain_lock:
+            self._chain_seq += 1
 
     def _add_pod_to_cache(self, pod: api.Pod) -> None:
         try:
@@ -283,6 +316,9 @@ class Scheduler:
                         qpods: List[QueuedPodInfo]) -> List[ScheduleOutcome]:
         trace = Trace("Scheduling", profile=fwk.profile_name,
                       pods=len(qpods))
+        # capture the event sequence BEFORE snapshotting: a chain is only
+        # reusable if no event has landed since the state it embeds
+        chain_seq0 = self._chain_seq
         # ---- snapshot (reference: generic_scheduler.go:155 snapshot())
         self.cache.update_snapshot(self.snapshot)
         node_infos = self.snapshot.node_info_list
@@ -318,13 +354,35 @@ class Scheduler:
                                            preemption_may_help=False))
             return outcomes
 
-        # ---- tensorize
-        builder = SnapshotBuilder(
-            hard_pod_affinity_weight=fwk.hard_pod_affinity_weight)
+        # ---- tensorize, or reuse the CHAINED cluster: the previous gang
+        # cycle's materialized tensors already ARE this snapshot (no
+        # unaccounted event landed), so skip the full rebuild entirely
         pinfos = [PodInfo(qp.pod) for qp in live]
-        builder.intern_pending(pinfos)
-        host_arrays = builder.build(node_infos)
-        cluster = host_arrays.to_device()
+        chain = self._chain
+        use_chain = (chain is not None and chain["seq"] == chain_seq0
+                     and self.config.mode == "gang" and self._mesh is None
+                     and getattr(self.config, "chain_cycles", True)
+                     and chain["profile"] == fwk.profile_name
+                     and chain["n_nodes"] == n_nodes)
+        if use_chain:
+            builder = chain["builder"]
+            builder.intern_pending(pinfos)
+            if _vocab_caps(builder.table) != chain["caps"]:
+                use_chain = False   # vocab bucket overflow: rebuild
+        if use_chain:
+            cluster = chain["cluster"]
+            chain_pod_uids = chain["pod_uids"]
+        else:
+            builder = SnapshotBuilder(
+                hard_pod_affinity_weight=fwk.hard_pod_affinity_weight)
+            builder.intern_pending(pinfos)
+            host_arrays = builder.build(node_infos)
+            cluster = host_arrays.to_device()
+            chain_pod_uids = [pi.pod.uid for ni in node_infos
+                              for pi in ni.pods]
+            chain_pod_uids += [None] * (int(cluster.pod_valid.shape[0])
+                                        - len(chain_pod_uids))
+            self._chain = None
         spread_sels = [self.store.default_spread_selector(pi.pod)
                        for pi in pinfos]
         pb = PodBatchBuilder(builder.table)
@@ -366,6 +424,11 @@ class Scheduler:
             builder=builder, cluster=cluster, cfg=cfg,
             node_infos=node_infos, batch=batch,
             row_of={qp.pod.uid: i for i, qp in enumerate(live)})
+        # existing-pod tensor rows by uid (chained clusters' row order
+        # diverges from node_infos build order; preemption victim masking
+        # needs the true mapping)
+        cycle_ctx.pod_rows = {uid: i for i, uid in enumerate(chain_pod_uids)
+                              if uid}
         trace.step("Tensorizing snapshot and pod batch done")
 
         if self.extenders:
@@ -422,7 +485,8 @@ class Scheduler:
                     else None,
                     start_index=start)
             self._next_start_node_index = int(res.next_start)
-        chosen = np.asarray(res.chosen)[:len(live)]
+        chosen_full = np.asarray(res.chosen)
+        chosen = chosen_full[:len(live)]
         n_feas = np.asarray(res.n_feasible)[:len(live)]
         unres = np.asarray(res.all_unresolvable)[:len(live)]
         trace.step("Computing predicates and priorities on device done")
@@ -432,6 +496,7 @@ class Scheduler:
         # verdict refresh against the final committed state (N failed pods
         # cost one [B, N] pass, not N)
         deferred = []  # (outcome index, qp, state, message, may_help)
+        commit_failed = False
         for i, qp in enumerate(live):
             state = states[qp.pod.uid]
             if chosen[i] < 0:
@@ -447,6 +512,8 @@ class Scheduler:
                 # preemption for pods failing later in this batch must see
                 # this placement (CycleContext.cluster_now overlay)
                 cycle_ctx.note_commit(i, int(chosen[i]))
+            else:
+                commit_failed = True
             outcomes.append(outcome)
         # pod_verdicts refreshes the shared verdicts lazily on the FIRST
         # preemption attempt that needs them (and the min-priority gate may
@@ -455,6 +522,45 @@ class Scheduler:
             outcomes[idx] = self._fail(fwk, qp, state, "", msg,
                                        preemption_may_help=mh,
                                        cycle=cycle_ctx)
+        # ---- chain the materialized cluster into the next cycle (gang
+        # only; a commit-path failure means the device-side placements
+        # diverged from reality, so the chain cannot be trusted)
+        chain_ok = (self.config.mode == "gang" and self._mesh is None
+                    and getattr(self.config, "chain_cycles", True)
+                    and not commit_failed)
+        if chain_ok:
+            from .utils.intern import pow2_bucket
+            B_cap = batch.valid.shape[0]
+            p_next = int(cluster.pod_valid.shape[0]) + B_cap
+            # never chain into a BIGGER pod-axis bucket than a fresh
+            # rebuild would use: pow2 slack compounds across cycles
+            # (bucket + B -> next bucket) and a rebuild compacts it —
+            # chaining past this line doubles HBM for nothing
+            fresh_p = pow2_bucket(self.cache.pod_count() + B_cap)
+            if pow2_bucket(p_next) > fresh_p:
+                chain_ok = False
+        if chain_ok:
+            from .models.gang import materialize_assigned
+            ta = batch.raa.valid.shape[1]
+            e_next = int(cluster.filter_terms.valid.shape[0]) + B_cap * ta
+            next_cluster = materialize_assigned(
+                cluster, batch, self._jax.numpy.asarray(chosen_full),
+                res.requested, res.nz, res.ports_used,
+                pad_pods_to=pow2_bucket(p_next),
+                pad_terms_to=pow2_bucket(e_next),
+                extend_score_terms=True,
+                hard_pod_affinity_weight=float(
+                    fwk.hard_pod_affinity_weight))
+            uids = list(chain_pod_uids)
+            uids.extend(pi.pod.uid for pi in pinfos)
+            uids.extend([None] * (B_cap - len(pinfos)))  # batch padding
+            uids.extend([None] * (pow2_bucket(p_next) - len(uids)))
+            self._chain = dict(builder=builder, cluster=next_cluster,
+                               pod_uids=uids, seq=chain_seq0,
+                               caps=_vocab_caps(builder.table),
+                               profile=fwk.profile_name, n_nodes=n_nodes)
+        elif self.config.mode == "gang":
+            self._chain = None
         trace.step("Committing placements done")
         trace.log_if_long()
         return outcomes
@@ -713,6 +819,10 @@ class Scheduler:
         return None
 
     def _forget(self, assumed: api.Pod) -> None:
+        # a rolled-back placement invalidates the chained cluster (it may
+        # already carry this pod's usage)
+        self._chain = None
+        self._mark_chain_dirty()
         try:
             self.cache.forget_pod(assumed)
         except ValueError:

@@ -65,7 +65,12 @@ class Snapshot:
 
 class SchedulerCache:
     def __init__(self, ttl: float = DEFAULT_ASSUME_TTL,
-                 clock=time.time, cleanup_period: float = 1.0):
+                 clock=time.time, cleanup_period: float = 1.0,
+                 expire_listener=None):
+        # expire_listener(pod): called whenever an assumed pod is dropped
+        # by TTL expiry (the lost-watch-event path) so owners of derived
+        # state (the scheduler's chained tensors) can invalidate it
+        self.expire_listener = expire_listener
         self._ttl = ttl
         self._clock = clock
         self._lock = threading.RLock()
@@ -349,6 +354,10 @@ class SchedulerCache:
         self._remove_pod(st.pod)
         del self.pod_states[uid]
         del self.assumed_pods[uid]
+        if self.expire_listener is not None:
+            # the scheduler's chained tensors may still carry this ghost
+            # pod's usage — let the owner invalidate them
+            self.expire_listener(st.pod)
 
     def run(self) -> None:
         """Start the periodic expiry loop (reference: cache.go:696 run)."""
