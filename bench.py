@@ -10,6 +10,19 @@ the reference's scheduler_perf density benchmark whose hard floor is
 (kubetpu/models/gang.py); the sequential-replay scan (exact serial
 semantics, scheduler.go:509) is reported in the detail line.
 
+Device time is measured where it is actually observable on this hardware:
+the scheduler's single per-cycle packed readback (Scheduler.device_wait_s).
+jax.block_until_ready does NOT block through the axon tunnel, so wall-clock
+around dispatch is meaningless — only the readback wait is real.
+
+Extra cases in the detail line:
+- "chain_drain": the 4096-pod workload drained in 1024-pod cycles with
+  cycle chaining ON vs OFF — the multi-cycle serving shape (VERDICT r3 #3).
+- BENCH_FULL=1 adds the BASELINE.md north-star shapes (>=10k nodes) and
+  writes NORTHSTAR.json: 10k x 5k InterPodAffinity-heavy e2e and a
+  100k x 10k streaming rescore (score-only, autoscaler-simulate) with HBM
+  accounting.
+
 Every unscheduled pod is attributed to the filter(s) that blocked it
 (programs.explain_filters) — no unexplained failures.
 
@@ -26,7 +39,8 @@ import time
 import numpy as np
 
 
-def build_world(n_nodes, n_pods, existing_per_node, store=None):
+def build_world(n_nodes, n_pods, existing_per_node, store=None,
+                ipa_heavy=False):
     from kubetpu.api import types as api
     from kubetpu.client.store import ClusterStore
     from kubetpu.harness import hollow
@@ -40,13 +54,25 @@ def build_world(n_nodes, n_pods, existing_per_node, store=None):
             p.spec.node_name = n.name
             store.add(p)
     pending = hollow.make_pods(n_pods, prefix="pend-", group_labels=16)
-    # topology work mixed in like scheduler_perf's blended configs:
-    # 1/3 soft zone spread, 1/5 hostname anti-affinity on the app group
-    for i, p in enumerate(pending):
-        if i % 3 == 0:
-            hollow.with_spread(p, api.LABEL_ZONE, when="ScheduleAnyway")
-        if i % 5 == 0:
-            hollow.with_anti_affinity(p, api.LABEL_HOSTNAME)
+    if ipa_heavy:
+        # the 10k x 5k north-star case: EVERY pod carries topology terms
+        # (BASELINE.md "InterPodAffinity-heavy"); zone affinity pulls the
+        # app group together, hostname anti-affinity pushes replicas apart
+        for i, p in enumerate(pending):
+            if i % 2 == 0:
+                hollow.with_anti_affinity(p, api.LABEL_HOSTNAME)
+            else:
+                hollow.with_affinity(p, api.LABEL_ZONE)
+            if i % 3 == 0:
+                hollow.with_spread(p, api.LABEL_ZONE, when="ScheduleAnyway")
+    else:
+        # topology work mixed in like scheduler_perf's blended configs:
+        # 1/3 soft zone spread, 1/5 hostname anti-affinity on the app group
+        for i, p in enumerate(pending):
+            if i % 3 == 0:
+                hollow.with_spread(p, api.LABEL_ZONE, when="ScheduleAnyway")
+            if i % 5 == 0:
+                hollow.with_anti_affinity(p, api.LABEL_HOSTNAME)
     return store, pending
 
 
@@ -59,7 +85,7 @@ def _percentile(xs, q):
 
 
 def run_mode(mode, n_nodes, n_pods, existing_per_node, repeats,
-             mesh_shape=None, batch_cap=None):
+             mesh_shape=None, batch_cap=None, chain=None, ipa_heavy=False):
     """One full e2e measurement: fresh store + scheduler per attempt; the
     first attempt pays XLA compiles (bounded by the persistent cache),
     later attempts reuse the in-process jit cache.  Pod counts above
@@ -67,77 +93,53 @@ def run_mode(mode, n_nodes, n_pods, existing_per_node, repeats,
     the serving loop's real shape."""
     from kubetpu.apis.config import (KubeSchedulerConfiguration,
                                      KubeSchedulerProfile)
-    from kubetpu.models import gang as gang_mod
-    from kubetpu.models import sequential as seq_mod
     from kubetpu.scheduler import Scheduler
 
     batch_cap = batch_cap or int(os.environ.get("BENCH_BATCH", "4096"))
+    if chain is None:
+        chain = os.environ.get("BENCH_CHAIN", "1") != "0"
 
-    # wrap the device programs to split device vs host time per cycle
-    device_s = [0.0]
-
-    def timed(fn):
-        def wrap(*a, **kw):
-            t0 = time.time()
-            res = fn(*a, **kw)
-            import jax
-            jax.block_until_ready(res.chosen)
-            device_s[0] += time.time() - t0
-            return res
-        return wrap
-
-    from kubetpu import scheduler as sched_mod
-    # time the INNER jitted programs, not run_auction — the auction wrapper
-    # does host-side gather/merge work that must count as host time
-    orig_gang = gang_mod.schedule_gang
-    orig_seq = sched_mod.schedule_sequential
     best = float("inf")
     first = None
     stats = None
     outcomes = sched = None
-    try:
-        gang_mod.schedule_gang = timed(orig_gang)
-        sched_mod.schedule_sequential = timed(orig_seq)
-        for attempt in range(repeats + 1):
-            if sched is not None:
-                sched.close()
-            store, pending = build_world(n_nodes, n_pods, existing_per_node)
-            cfg = KubeSchedulerConfiguration(
-                profiles=[KubeSchedulerProfile()],
-                batch_size=min(n_pods, batch_cap), mode=mode,
-                mesh_shape=mesh_shape,
-                chain_cycles=os.environ.get("BENCH_CHAIN", "1") != "0")
-            sched = Scheduler(store, config=cfg, async_binding=False)
-            for p in pending:
-                store.add(p)
-            device_s[0] = 0.0
-            outcomes = []
-            cycle_times = []
-            t0 = time.time()
-            while True:
-                tc = time.time()
-                out = sched.schedule_pending(timeout=0.2)
-                if not out:
-                    break
-                cycle_times.append(time.time() - tc)
-                outcomes.extend(out)
-            dt = time.time() - t0
-            if attempt == 0:
-                first = dt
-            else:
-                best = min(best, dt)
-            stats = {
-                "cycles": len(cycle_times),
-                "cycle_p50_s": round(_percentile(cycle_times, 0.5), 3),
-                "cycle_p99_s": round(_percentile(cycle_times, 0.99), 3),
-                "device_s": round(device_s[0], 3),
-                "host_share": round(1.0 - device_s[0] / max(dt, 1e-9), 3),
-            }
-        if repeats == 0:
-            best = first
-    finally:
-        gang_mod.schedule_gang = orig_gang
-        sched_mod.schedule_sequential = orig_seq
+    for attempt in range(repeats + 1):
+        if sched is not None:
+            sched.close()
+        store, pending = build_world(n_nodes, n_pods, existing_per_node,
+                                     ipa_heavy=ipa_heavy)
+        cfg = KubeSchedulerConfiguration(
+            profiles=[KubeSchedulerProfile()],
+            batch_size=min(n_pods, batch_cap), mode=mode,
+            mesh_shape=mesh_shape, chain_cycles=chain)
+        sched = Scheduler(store, config=cfg, async_binding=False)
+        for p in pending:
+            store.add(p)
+        sched.device_wait_s = 0.0
+        outcomes = []
+        cycle_times = []
+        t0 = time.time()
+        while True:
+            tc = time.time()
+            out = sched.schedule_pending(timeout=0.2)
+            if not out:
+                break
+            cycle_times.append(time.time() - tc)
+            outcomes.extend(out)
+        dt = time.time() - t0
+        if attempt == 0:
+            first = dt
+        else:
+            best = min(best, dt)
+        stats = {
+            "cycles": len(cycle_times),
+            "cycle_p50_s": round(_percentile(cycle_times, 0.5), 3),
+            "cycle_p99_s": round(_percentile(cycle_times, 0.99), 3),
+            "device_wait_s": round(sched.device_wait_s, 3),
+            "host_share": round(1.0 - sched.device_wait_s / max(dt, 1e-9), 3),
+        }
+    if repeats == 0:
+        best = first
     return best, first, outcomes, sched, stats
 
 
@@ -177,12 +179,120 @@ def explain(sched, outcomes):
     return counts
 
 
+def mode_summary(mode, best, first, outcomes, sched, stats):
+    scheduled = sum(1 for o in outcomes if o.node)
+    d = {"e2e_best_s": round(best, 3),
+         "first_run_s": round(first, 3),
+         "compile_s": round(first - best, 1),
+         "scheduled": scheduled}
+    d.update(stats or {})
+    if scheduled < len(outcomes):
+        d["unscheduled_by_filter"] = explain(sched, outcomes)
+    return d, len(outcomes) / best
+
+
+def chain_drain_case(n_nodes, n_pods, existing_per_node):
+    """Multi-cycle drain (batch_cap << n_pods): chaining ON reuses the
+    previous cycle's materialized device cluster; OFF re-tensorizes the
+    snapshot every cycle.  The VERDICT r3 ask: a measured number that
+    justifies the feature (or its removal)."""
+    out = {}
+    cap = max(256, n_pods // 4)
+    for label, chain in (("chain_on", True), ("chain_off", False)):
+        best, first, outcomes, sched, stats = run_mode(
+            "gang", n_nodes, n_pods, existing_per_node, repeats=1,
+            batch_cap=cap, chain=chain)
+        d, pods_per_sec = mode_summary("gang", best, first, outcomes, sched,
+                                       stats)
+        sched.close()
+        d["pods_per_sec"] = round(pods_per_sec, 1)
+        out[label] = d
+    on, off = out["chain_on"], out["chain_off"]
+    out["speedup"] = round(off["e2e_best_s"] / max(on["e2e_best_s"], 1e-9), 3)
+    out["batch_cap"] = cap
+    return out
+
+
+def rescore_case(n_pods=102400, n_nodes=10240, chunk=16384):
+    """North star: 100k x 10k STREAMING RESCORE (BASELINE.md "autoscaler
+    simulate"): filter+score+select every pending pod against the live
+    cluster, no binding.  Pods stream through the device in fixed chunks
+    (static shapes); per chunk the host reads back ONE [3B] packed array.
+    Reports pods/s and the device HBM footprint."""
+    import jax
+
+    from kubetpu.api import types as api
+    from kubetpu.framework.types import PodInfo
+    from kubetpu.models import programs
+    from kubetpu.models.batch import PodBatchBuilder
+    from kubetpu.state.tensors import SnapshotBuilder
+    from kubetpu.harness import hollow
+    from kubetpu.client.store import ClusterStore
+
+    store, pending = build_world(n_nodes, n_pods=0, existing_per_node=1)
+    pending = hollow.make_pods(chunk, prefix="re-", group_labels=64)
+    for i, p in enumerate(pending):
+        if i % 3 == 0:
+            hollow.with_spread(p, api.LABEL_ZONE, when="ScheduleAnyway")
+        if i % 5 == 0:
+            hollow.with_anti_affinity(p, api.LABEL_HOSTNAME)
+
+    from kubetpu.scheduler import Scheduler
+    from kubetpu.apis.config import (KubeSchedulerConfiguration,
+                                     KubeSchedulerProfile)
+    sched = Scheduler(store, config=KubeSchedulerConfiguration(
+        profiles=[KubeSchedulerProfile()]), async_binding=False)
+    sched.cache.update_snapshot(sched.snapshot)
+    node_infos = sched.snapshot.node_info_list
+    fwk = next(iter(sched.profiles.values()))
+    pinfos = [PodInfo(p) for p in pending]
+    sb = SnapshotBuilder(hard_pod_affinity_weight=fwk.hard_pod_affinity_weight)
+    sb.intern_pending(pinfos)
+    cluster = sb.build(node_infos).to_device()
+    batch = jax.tree.map(np.asarray, PodBatchBuilder(sb.table).build(pinfos))
+    cfg = programs.ProgramConfig(
+        filters=fwk.tensor_filters, scores=fwk.tensor_scores,
+        hostname_topokey=max(sb.table.topokey.get(api.LABEL_HOSTNAME), 0),
+        plugin_args=fwk.tensor_plugin_args(sb.table))
+
+    @jax.jit
+    def rescore(cluster, batch, rng):
+        res, chosen = programs.schedule_batch(cluster, batch, cfg, rng)
+        return jax.numpy.concatenate(
+            [chosen, res.feasible.sum(axis=1).astype(jax.numpy.int32)])
+
+    rng = jax.random.PRNGKey(0)
+    n_chunks = (n_pods + chunk - 1) // chunk
+    # compile pass
+    t0 = time.time()
+    np.asarray(rescore(cluster, batch, rng))
+    compile_s = time.time() - t0
+    t0 = time.time()
+    placed = 0
+    for c in range(n_chunks):
+        packed = np.asarray(rescore(cluster, batch,
+                                    jax.random.fold_in(rng, c)))
+        placed += int((packed[:chunk] >= 0).sum())
+    dt = time.time() - t0
+    mem = jax.local_devices()[0].memory_stats() or {}
+    sched.close()
+    return {
+        "pods": n_pods, "nodes": n_nodes, "chunk": chunk,
+        "e2e_s": round(dt, 3), "compile_s": round(compile_s, 1),
+        "pods_per_sec": round(n_pods / dt, 1),
+        "placed_per_chunk": placed // n_chunks,
+        "hbm_peak_bytes": int(mem.get("peak_bytes_in_use", 0)),
+        "hbm_in_use_bytes": int(mem.get("bytes_in_use", 0)),
+    }
+
+
 def main() -> None:
     n_nodes = int(os.environ.get("BENCH_NODES", "1000"))
     n_pods = int(os.environ.get("BENCH_PODS", "4096"))
     existing_per_node = int(os.environ.get("BENCH_EXISTING_PER_NODE", "2"))
     repeats = int(os.environ.get("BENCH_REPEATS", "2"))
     modes = os.environ.get("BENCH_MODES", "gang,sequential").split(",")
+    full = os.environ.get("BENCH_FULL", "0") == "1"
 
     mesh_shape = None
     if os.environ.get("BENCH_MESH"):
@@ -209,18 +319,31 @@ def main() -> None:
         best, first, outcomes, sched, stats = run_mode(
             mode, n_nodes, n_pods, existing_per_node, repeats,
             mesh_shape=mesh_shape)
-        scheduled = sum(1 for o in outcomes if o.node)
-        d = {"e2e_best_s": round(best, 3),
-             "first_run_s": round(first, 3),
-             "compile_s": round(first - best, 1),
-             "scheduled": scheduled}
-        d.update(stats or {})
-        if scheduled < len(outcomes):
-            d["unscheduled_by_filter"] = explain(sched, outcomes)
+        d, pods_per_sec = mode_summary(mode, best, first, outcomes, sched,
+                                       stats)
         detail[mode] = d
         sched.close()
         if headline is None:
-            headline = (mode, len(outcomes) / best)
+            headline = (mode, pods_per_sec)
+
+    if os.environ.get("BENCH_CHAIN_DRAIN", "1") == "1" and mesh_shape is None:
+        detail["chain_drain"] = chain_drain_case(n_nodes, n_pods,
+                                                 existing_per_node)
+
+    if full:
+        northstar = {}
+        best, first, outcomes, sched, stats = run_mode(
+            "gang", 5120, 10240, 1, repeats=1, batch_cap=10240,
+            ipa_heavy=True)
+        d, pods_per_sec = mode_summary("gang", best, first, outcomes, sched,
+                                       stats)
+        d["pods_per_sec"] = round(pods_per_sec, 1)
+        sched.close()
+        northstar["e2e_gang_10240x5120_ipa_heavy"] = d
+        northstar["rescore_100kx10k"] = rescore_case()
+        detail["northstar"] = northstar
+        with open("NORTHSTAR.json", "w") as f:
+            json.dump(northstar, f, indent=1)
 
     mode, pods_per_sec = headline
     baseline = 30.0  # reference hard throughput floor (scheduler_test.go:40)

@@ -424,6 +424,7 @@ class PersistentVolume:
 class StorageClass:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     volume_binding_mode: str = "Immediate"  # Immediate | WaitForFirstConsumer
+    provisioner: str = ""  # e.g. kubernetes.io/aws-ebs
     kind: str = "StorageClass"
 
 

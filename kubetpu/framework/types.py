@@ -166,6 +166,24 @@ class PodInfo:
         self.resource = compute_pod_resource_request(pod)
         self.non_zero_cpu, self.non_zero_mem = non_zero_request(pod)
 
+    def with_pod(self, pod: api.Pod) -> "PodInfo":
+        """Rewrap a pod object that shares this one's parsed spec content
+        (e.g. the scheduler's assumed shallow-copy with node_name set) —
+        shares the parsed terms/resources instead of re-parsing.  Term and
+        resource parsing dominates PodInfo cost (quantity parsing is
+        string work), and the commit path would otherwise re-do it for
+        every scheduled pod."""
+        pi = PodInfo.__new__(PodInfo)
+        pi.pod = pod
+        pi.required_affinity_terms = self.required_affinity_terms
+        pi.required_anti_affinity_terms = self.required_anti_affinity_terms
+        pi.preferred_affinity_terms = self.preferred_affinity_terms
+        pi.preferred_anti_affinity_terms = self.preferred_anti_affinity_terms
+        pi.resource = self.resource
+        pi.non_zero_cpu = self.non_zero_cpu
+        pi.non_zero_mem = self.non_zero_mem
+        return pi
+
 
 @dataclass
 class QueuedPodInfo:
@@ -241,9 +259,11 @@ class NodeInfo:
                 self.image_states[name] = img.size_bytes
         self.generation = next_generation()
 
-    def add_pod(self, pod: api.Pod) -> None:
-        # reference: types.go:456 (AddPod)
-        pi = PodInfo(pod)
+    def add_pod(self, pod: api.Pod, pinfo: Optional[PodInfo] = None) -> None:
+        # reference: types.go:456 (AddPod).  pinfo: optional pre-parsed
+        # PodInfo wrapping THIS pod object (callers on the hot path pass it
+        # to skip re-parsing terms/resources).
+        pi = pinfo if pinfo is not None and pinfo.pod is pod else PodInfo(pod)
         self.pods.append(pi)
         if pod_with_affinity(pod):
             self.pods_with_affinity.append(pi)

@@ -91,6 +91,11 @@ class GangResult(NamedTuple):
     all_unresolvable: jnp.ndarray  # [B] bool — every failed node failed
                             # UnschedulableAndUnresolvable (preemption gate,
                             # scheduler.go:391; matches SeqResult's field)
+    packed: jnp.ndarray     # [3*B] i32 = concat(chosen, n_feasible,
+                            # all_unresolvable) — the host's per-cycle view
+                            # in ONE device->host readback (the tunnel pays
+                            # ~100 ms latency PER transfer, so the serving
+                            # loop must pull exactly one small array)
 
 
 def _segment_base(values: jnp.ndarray, is_start: jnp.ndarray) -> jnp.ndarray:
@@ -241,65 +246,21 @@ def materialize_assigned(cluster, batch, chosen, requested, nz, ports_used,
 
 
 def run_auction(cluster, batch, cfg: ProgramConfig, rng,
-                host_ok=None, intra_batch_topology: bool = True,
-                min_bucket: int = 16) -> GangResult:
-    """Two-phase gang auction (HOST orchestrator, not jitted).
+                host_ok=None, intra_batch_topology: bool = True) -> GangResult:
+    """The serving-loop gang entry: ONE device dispatch, ONE small readback.
 
-    Phase 1 runs ONE full-batch propose/admit round — the uncontended
-    majority admits here.  Phase 2 re-auctions only the losers: their rows
-    gather into a pow2 bucket (gather_batch_rows) against the cluster with
-    phase 1's placements materialized, so the expensive per-round
-    filter+score work is sized by the CONTENDED pod count, not B.  The
-    monolithic while_loop (schedule_gang) pays ~B-sized work every round
-    by static-shape necessity; this wrapper is the throughput path the
-    serving loop uses.  Residual pods keep their ORIGINAL tie-break
-    stream ids and admission order, and phase 1's placements are
-    materialized exactly as the loop's carry would see them, so the
-    two-phase result replays the monolithic loop's placements."""
-    import numpy as np
-    from .batch import gather_batch_rows
-    from ..utils.intern import pow2_bucket
-
-    B = np.asarray(batch.valid).shape[0]
-    res0 = schedule_gang(cluster, batch, cfg, rng, host_ok=host_ok,
-                         max_rounds=1,
+    Round 3 ran a two-phase host-orchestrated residual auction here (full
+    round, pull losers to host, re-auction a gathered pow2 bucket).  That
+    traded device FLOPs for host round trips — the right trade when a
+    full-batch round cost ~1.2 s of scatter-bound device time.  The
+    same-pair MATMUL kernels dropped a 4096x1000 full-matrix round to
+    ~10 ms, while every device->host transfer costs ~100 ms of tunnel
+    latency; the two-phase wrapper's 3+ intermediate syncs now cost an
+    order of magnitude more than the full-batch rounds they avoid.  The
+    monolithic while_loop (all rounds on device, zero intermediate syncs)
+    is strictly faster at every measured shape, so it IS the auction."""
+    return schedule_gang(cluster, batch, cfg, rng, host_ok=host_ok,
                          intra_batch_topology=intra_batch_topology)
-    chosen0 = np.asarray(res0.chosen)
-    valid = np.asarray(batch.valid)
-    rows = np.nonzero((chosen0 < 0) & valid)[0]
-    if rows.size == 0:
-        return res0
-    if rows.size > B // 2:
-        # heavily contended: the monolithic loop does no redundant work
-        return schedule_gang(cluster, batch, cfg, rng, host_ok=host_ok,
-                             intra_batch_topology=intra_batch_topology)
-    U = pow2_bucket(rows.size, min_bucket)
-    pad = np.full((U,), -1, np.int64)
-    pad[:rows.size] = rows
-    sub = gather_batch_rows(batch, pad)
-    sub_ok = None
-    if host_ok is not None:
-        sub_ok = jnp.asarray(np.asarray(host_ok)[np.clip(pad, 0, B - 1)])
-    ext = materialize_assigned(cluster, batch, res0.chosen, res0.requested,
-                               res0.nz, res0.ports_used)
-    res1 = schedule_gang(ext, sub, cfg, rng, host_ok=sub_ok,
-                         intra_batch_topology=intra_batch_topology,
-                         tie_index=jnp.asarray(np.clip(pad, 0, B - 1),
-                                               jnp.int32))
-    chosen1 = np.asarray(res1.chosen)[:rows.size]
-    score1 = np.asarray(res1.score)[:rows.size]
-    chosen = chosen0.copy()
-    chosen[rows] = chosen1
-    score = np.asarray(res0.score).copy()
-    score[rows] = score1
-    return GangResult(
-        chosen=chosen, score=score,
-        rounds=res0.rounds + res1.rounds,
-        requested=res1.requested, nz=res1.nz,
-        ports_used=jnp.maximum(res0.ports_used, res1.ports_used),
-        feasible0=res0.feasible0, unresolvable=res0.unresolvable,
-        n_feasible=res0.n_feasible,
-        all_unresolvable=res0.all_unresolvable)
 
 
 @functools.partial(jax.jit,
@@ -576,10 +537,12 @@ def schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
     out = jax.lax.while_loop(cond, body, carry0)
     unresolvable = out["unres"]
     all_unres = jnp.all(unresolvable | out["feas0"] | ~base, axis=1)
+    n_feas = jnp.sum(out["feas0"].astype(jnp.int32), axis=1)
+    packed = jnp.concatenate([out["assigned"], n_feas,
+                              all_unres.astype(jnp.int32)])
     return GangResult(chosen=out["assigned"], score=out["win_score"],
                       rounds=out["rounds"], requested=out["req"],
                       nz=out["nz"], ports_used=out["ports_used"],
                       feasible0=out["feas0"], unresolvable=unresolvable,
-                      n_feasible=jnp.sum(out["feas0"].astype(jnp.int32),
-                                         axis=1),
-                      all_unresolvable=all_unres)
+                      n_feasible=n_feas,
+                      all_unresolvable=all_unres, packed=packed)

@@ -47,6 +47,11 @@ class SeqResult(NamedTuple):
     next_start: jnp.ndarray    # i32 — rotated start index after the batch
                                # (reference: nextStartNodeIndex,
                                # generic_scheduler.go:451,487)
+    packed: jnp.ndarray        # [3*B+1] i32 = concat(chosen, n_feasible,
+                               # all_unresolvable, [next_start]) — the
+                               # host's whole per-cycle view in ONE
+                               # device->host readback (tunnel transfers
+                               # pay ~100 ms latency each)
 
 
 def _num_feasible_nodes_to_find(n_valid, pct: int):
@@ -595,6 +600,8 @@ def schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
         step, carry0, jnp.arange(B))
     next_start = carry["start"] if sample else jnp.asarray(start_index,
                                                            jnp.int32)
+    packed = jnp.concatenate([chosen, n_feas, all_unres.astype(jnp.int32),
+                              next_start[None]])
     return SeqResult(chosen=chosen, score=score, n_feasible=n_feas,
                      all_unresolvable=all_unres, requested=carry["req"],
-                     next_start=next_start)
+                     next_start=next_start, packed=packed)

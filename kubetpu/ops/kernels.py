@@ -46,12 +46,62 @@ def _idiv(a, b):
 
 
 def per_node_counts(match_sp: jnp.ndarray, pod_node: jnp.ndarray, n_nodes: int) -> jnp.ndarray:
-    """[S, P] per-existing-pod values -> [S, N] per-node sums."""
-    data = _f(match_sp).T  # [P, S]
-    seg = jax.ops.segment_sum(data, jnp.clip(pod_node, 0, n_nodes - 1),
-                              num_segments=n_nodes,
-                              indices_are_sorted=False)
-    return seg.T
+    """[S, P] per-existing-pod values -> [S, N] per-node sums.
+
+    One-hot MATMUL, not a scatter: TPU scatters serialize, while a
+    [S, P] x [P, N] contraction rides the MXU.  bf16 inputs are exact for
+    the bool/small-int values every caller passes (products are exact and
+    the MXU accumulates in f32), so counts are bit-exact up to 2^24."""
+    oh = (pod_node[:, None] == jnp.arange(n_nodes)[None, :])  # [P, N]
+    return jnp.einsum("sp,pn->sn", match_sp.astype(jnp.bfloat16),
+                      oh.astype(jnp.bfloat16),
+                      preferred_element_type=jnp.float32)
+
+
+def _samepair_pods_to_nodes(cluster, values_sp: jnp.ndarray,
+                            keys_s: jnp.ndarray, pod_node: jnp.ndarray,
+                            pod_valid: jnp.ndarray) -> jnp.ndarray:
+    """out[s, n] = sum of values[s, p] over existing pods p placed on a node
+    sharing node n's (keys_s[s], value) topology pair.
+
+    This is the MXU form of scatter-to-pair-space + gather-back-to-nodes
+    (pair_scatter/pair_gather): one [S, P] x [P, N] matmul per topology key
+    (TK static, unrolled), with the same-pair membership matrix built
+    elementwise.  Rows whose key id is out of [0, TK) yield zeros; nodes
+    without the key receive 0; pods on nodes without the key contribute
+    nothing.  values must be bf16-exact per element (bools or small ints —
+    accumulation is f32 on the MXU, so sums are exact)."""
+    tp = cluster.topo_pair                      # [N, TK]
+    TK = tp.shape[1]
+    pod_tp = jnp.take(tp, jnp.clip(pod_node, 0, None), axis=0)  # [P, TK]
+    placed = (pod_node >= 0) & pod_valid
+    vals = values_sp.astype(jnp.bfloat16)
+    out = jnp.zeros((values_sp.shape[0], tp.shape[0]), jnp.float32)
+    for k in range(TK):
+        pk = jnp.where(placed, pod_tp[:, k], -1)            # [P]
+        sp = (pk[:, None] == tp[None, :, k]) & (pk >= 0)[:, None]
+        red = jnp.einsum("sp,pn->sn", vals, sp.astype(jnp.bfloat16),
+                         preferred_element_type=jnp.float32)
+        out = jnp.where((keys_s == k)[:, None], red, out)
+    return out
+
+
+def _samepair_nodes(cluster, values_sn: jnp.ndarray,
+                    keys_s: jnp.ndarray) -> jnp.ndarray:
+    """out[s, n] = sum of values[s, n'] over nodes n' sharing node n's
+    (keys_s[s], value) pair — the node-valued sibling of
+    _samepair_pods_to_nodes ([S, N] x [N, N] matmul per key)."""
+    tp = cluster.topo_pair
+    TK = tp.shape[1]
+    vals = values_sn.astype(jnp.bfloat16)
+    out = jnp.zeros(values_sn.shape, jnp.float32)
+    for k in range(TK):
+        col = tp[:, k]
+        sp = (col[:, None] == col[None, :]) & (col >= 0)[:, None]
+        red = jnp.einsum("sn,nm->sm", vals, sp.astype(jnp.bfloat16),
+                         preferred_element_type=jnp.float32)
+        out = jnp.where((keys_s == k)[:, None], red, out)
+    return out
 
 
 def pair_scatter(values_sn: jnp.ndarray, pair_sn: jnp.ndarray, L: int) -> jnp.ndarray:
@@ -242,27 +292,44 @@ def _spread_state(cluster, batch, constraints, affinity_ok, count_mask_nodes,
 
 def spread_filter(cluster, batch, affinity_ok, match_ns=None) -> jnp.ndarray:
     """PodTopologySpread hard constraints
-    (reference: podtopologyspread/filtering.go:200-283 calPreFilterState/Filter)."""
+    (reference: podtopologyspread/filtering.go:200-283 calPreFilterState/Filter).
+
+    Node-space formulation: pair aggregates are constant across a pair's
+    member nodes, so "min over registered pairs" == "min over nodes of
+    registered pairs" and no explicit pair axis is needed — everything is
+    same-pair matmuls on the MXU (see _samepair_pods_to_nodes)."""
     cons = batch.spread
     B, C = cons.topo_key.shape
     N = cluster.allocatable.shape[0]
-    st = _spread_state(cluster, batch, cons, affinity_ok,
-                       cluster.node_valid[None, :] & jnp.ones((B, N), bool),
-                       match_ns=match_ns)
-    # min match per constraint over *registered* pairs
+    if match_ns is None:
+        match_ns = spread_match_ns(cluster, batch, cons)
+    countable = cluster.pod_valid & ~cluster.pod_terminating
+    m = (match_ns & countable[None, None, :]).reshape(B * C, -1)
+    keys = jnp.where(cons.topo_known, cons.topo_key, -1).reshape(-1)
+    # matching-pod count of each node's pair, per constraint  [B*C, N]
+    cnt = _samepair_pods_to_nodes(cluster, m, keys, cluster.pod_node,
+                                  cluster.pod_valid)
+    node_pair = node_topo_pairs(cluster, cons.topo_key.reshape(-1))
+    has_key = ((node_pair >= 0).reshape(B, C, N)
+               & cons.topo_known.reshape(B, C)[:, :, None])
+    all_keys = jnp.all(has_key | ~cons.valid[:, :, None], axis=1)  # [B, N]
+    eligible = affinity_ok & cluster.node_valid[None, :] & all_keys
+    any_eligible = jnp.any(eligible, axis=1)
+    # a pair is registered iff some eligible node carries it
+    elig_bc = jnp.broadcast_to(eligible[:, None, :], (B, C, N)).reshape(B * C, N)
+    registered = _samepair_nodes(cluster, elig_bc, keys) > 0.5  # [B*C, N]
     big = jnp.float32(2**31)
-    masked = jnp.where(st.registered, st.pair_counts, big)
-    min_match = jnp.min(masked, axis=1).reshape(B, C)  # [B, C]
-    match_num = pair_gather(jnp.where(st.registered, st.pair_counts, 0.0),
-                            st.node_pair).reshape(B, C, N)
+    min_match = jnp.min(jnp.where(registered, cnt, big),
+                        axis=1).reshape(B, C)
     # unregistered pair => matchNum 0 (reference Filter: nil *tpCount)
+    match_num = jnp.where(registered, cnt, 0.0).reshape(B, C, N)
     self_m = _f(cons.self_match)[:, :, None]
     skew = match_num + self_m - min_match[:, :, None]
-    c_ok = st.has_key & (skew <= cons.max_skew[:, :, None])
+    c_ok = has_key & (skew <= cons.max_skew[:, :, None])
     ok = jnp.all(c_ok | ~cons.valid[:, :, None], axis=1)
     has_any = jnp.any(cons.valid, axis=1)
     # empty preFilterState (no eligible nodes anywhere) tolerates every pod
-    return jnp.where(has_any[:, None] & st.any_eligible[:, None], ok, True)
+    return jnp.where(has_any[:, None] & any_eligible[:, None], ok, True)
 
 
 def spread_soft_score(cluster, batch, feasible, affinity_ok,
@@ -273,32 +340,53 @@ def spread_soft_score(cluster, batch, feasible, affinity_ok,
     B, C = cons.topo_key.shape
     N = cluster.allocatable.shape[0]
     count_nodes = affinity_ok & cluster.node_valid[None, :]
-    # pairs are registered from *filtered* nodes only
-    st = _spread_state(cluster, batch, cons, feasible, count_nodes,
-                       match_ns=match_ns)
+    if match_ns is None:
+        match_ns = spread_match_ns(cluster, batch, cons)
+    countable = cluster.pod_valid & ~cluster.pod_terminating
+    m = match_ns & countable[None, None, :]          # [B, C, P]
+    keys = jnp.where(cons.topo_known, cons.topo_key, -1).reshape(-1)
+    node_pair = node_topo_pairs(cluster, cons.topo_key.reshape(-1))
+    has_key = ((node_pair >= 0).reshape(B, C, N)
+               & cons.topo_known.reshape(B, C)[:, :, None])
     is_host = (cons.topo_key == hostname_topokey) & cons.topo_known
     valid = cons.valid
 
-    # ignored nodes: filtered but missing some constraint key
-    all_keys = jnp.all(st.has_key | ~valid[:, :, None], axis=1)  # [B, N]
+    # per-node match counts (hostname constraints read these directly)
+    node_counts = per_node_counts(m.reshape(B * C, -1), cluster.pod_node,
+                                  N).reshape(B, C, N)
+    # pair sums count only pods on PreScore-eligible nodes
+    # (reference: scoring.go:139-165 counts over filtered+affinity nodes)
+    cm_pods = jnp.take_along_axis(
+        count_nodes, jnp.clip(cluster.pod_node, 0, None)[None, :], axis=1)
+    cm_pods = cm_pods & (cluster.pod_node >= 0)[None, :]     # [B, P]
+    m_counted = (m & cm_pods[:, None, :]).reshape(B * C, -1)
+    cnt_pair = _samepair_pods_to_nodes(cluster, m_counted, keys,
+                                       cluster.pod_node, cluster.pod_valid)
+
+    # eligibility / registration from *filtered* nodes only
+    all_keys = jnp.all(has_key | ~valid[:, :, None], axis=1)  # [B, N]
     ignored = feasible & ~all_keys
     scored = feasible & all_keys
+    eligible = feasible & cluster.node_valid[None, :] & all_keys
+    elig_bc = jnp.broadcast_to(eligible[:, None, :], (B, C, N)).reshape(B * C, N)
+    members = _samepair_nodes(cluster, elig_bc, keys)       # [B*C, N]
+    registered = members > 0.5
 
-    # hostname pairs are not registered (per-node counts used directly);
-    # emulate by removing hostname constraints from pair space
-    reg = st.registered.reshape(B, C, -1) & ~is_host[:, :, None]
-    topo_size = jnp.sum(_f(reg), axis=2)  # [B, C]
+    # distinct registered-pair count: each pair contributes
+    # sum-over-its-eligible-members of 1/members == exactly 1
+    inv = jnp.where(registered & elig_bc, 1.0 / jnp.maximum(members, 1.0),
+                    0.0)
+    topo_size = jnp.round(jnp.sum(inv, axis=1)).reshape(B, C)
     n_scored = jnp.sum(_f(scored), axis=1)  # [B]
     size = jnp.where(is_host, n_scored[:, None], topo_size)
     weight = jnp.log(size + 2.0)  # reference: scoring.go:286
 
-    pair_cnt = pair_gather(jnp.where(reg.reshape(B * C, -1), st.pair_counts, 0.0),
-                           st.node_pair).reshape(B, C, N)
-    cnt = jnp.where(is_host[:, :, None], st.node_counts, pair_cnt)
+    pair_cnt = jnp.where(registered, cnt_pair, 0.0).reshape(B, C, N)
+    cnt = jnp.where(is_host[:, :, None], node_counts, pair_cnt)
     # adjustForMaxSkew (scoring.go:294)
     ms = cons.max_skew[:, :, None]
     cnt = jnp.where(cnt < ms, ms - 1.0, cnt)
-    contrib = jnp.where((valid & cons.topo_known)[:, :, None] & st.has_key,
+    contrib = jnp.where((valid & cons.topo_known)[:, :, None] & has_key,
                         cnt * weight[:, :, None], 0.0)
     raw = jnp.floor(jnp.sum(contrib, axis=1))  # int64(score)
     raw = jnp.where(ignored, 0.0, raw)
@@ -379,7 +467,6 @@ def interpod_filter(cluster, batch,
     bootstrap branch (filtering.go:356) is what admits them."""
     B = batch.req.shape[0]
     N = cluster.allocatable.shape[0]
-    L = cluster.kv.shape[1]
     if pre is None:
         pre = interpod_filter_pre(cluster, batch)
 
@@ -389,17 +476,29 @@ def interpod_filter(cluster, batch,
     m = _pod_term_matches(cluster, ra, B, pre=pre.m_ra)  # [B, T, P]
     match_all = jnp.all(m | ~ra.valid[:, :, None], axis=1)  # [B, P]
     has_ra = jnp.any(ra.valid, axis=1)  # [B]
-    ep_pair = pod_topo_pairs(cluster, ra.topo_key.reshape(-1))  # [B*T, P]
+    keys_r = jnp.where(ra.topo_known, ra.topo_key, -1).reshape(-1)
     contrib = jnp.broadcast_to(match_all[:, None, :], m.shape).reshape(B * Tr, -1)
-    pair_counts = pair_scatter(contrib, ep_pair, L)  # [B*T, L]
+    cnt = _samepair_pods_to_nodes(cluster, contrib, keys_r,
+                                  cluster.pod_node, cluster.pod_valid)
     node_pair = node_topo_pairs(cluster, ra.topo_key.reshape(-1))  # [B*T, N]
     node_has_key = (node_pair >= 0).reshape(B, Tr, N) & ra.topo_known[:, :, None]
-    cnt = pair_gather(pair_counts, node_pair).reshape(B, Tr, N)
+    cnt = cnt.reshape(B, Tr, N)
     term_ok = node_has_key & (cnt > 0.5)
     aff_ok = jnp.all(term_ok | ~ra.valid[:, :, None], axis=1)
     # bootstrap: no matches anywhere + pod matches its own terms
-    # (filtering.go:356-366); node must still carry every topology key
-    no_matches = jnp.sum(pair_counts.reshape(B, -1), axis=1) < 0.5
+    # (filtering.go:356-366); node must still carry every topology key.
+    # "matches anywhere" counts matching pods on key-carrying nodes over
+    # VALID terms only (the reference's topologyToMatchedAffinityTerms map
+    # has entries only for (term, key-bearing-node) pods).
+    pod_tp = jnp.take(cluster.topo_pair, jnp.clip(cluster.pod_node, 0, None),
+                      axis=0)  # [P, TK]
+    pod_keyed = (jnp.take(pod_tp.T, jnp.clip(keys_r, 0, None), axis=0) >= 0) \
+        & (keys_r >= 0)[:, None] \
+        & (cluster.pod_node >= 0)[None, :] & cluster.pod_valid[None, :]
+    tot = jnp.sum(jnp.where(pod_keyed & contrib
+                            & ra.valid.reshape(-1)[:, None], 1.0, 0.0),
+                  axis=1)  # [B*Tr]
+    no_matches = jnp.sum(tot.reshape(B, Tr), axis=1) < 0.5
     self_all = jnp.all(ra.self_match | ~ra.valid, axis=1) & has_ra
     all_keys = jnp.all(node_has_key | ~ra.valid[:, :, None], axis=1)
     aff_ok = aff_ok | ((no_matches & self_all)[:, None] & all_keys)
@@ -409,25 +508,30 @@ def interpod_filter(cluster, batch,
     raa = batch.raa
     Ta = raa.valid.shape[1]
     ma = _pod_term_matches(cluster, raa, B, pre=pre.m_raa).reshape(B * Ta, -1)
-    ep_pair_a = pod_topo_pairs(cluster, raa.topo_key.reshape(-1))
-    pc_a = pair_scatter(ma, ep_pair_a, L)
+    keys_a = jnp.where(raa.topo_known, raa.topo_key, -1).reshape(-1)
+    cnt_a = _samepair_pods_to_nodes(cluster, ma, keys_a,
+                                    cluster.pod_node, cluster.pod_valid)
     np_a = node_topo_pairs(cluster, raa.topo_key.reshape(-1))
     has_key_a = (np_a >= 0).reshape(B, Ta, N) & raa.topo_known[:, :, None]
-    cnt_a = pair_gather(pc_a, np_a).reshape(B, Ta, N)
+    cnt_a = cnt_a.reshape(B, Ta, N)
     anti_fail = jnp.any(has_key_a & (cnt_a > 0.5) & raa.valid[:, :, None], axis=1)
 
     # --- existing pods' required anti-affinity
-    # (filtering.go:314 satisfyExistingPodsAntiAffinity)
+    # (filtering.go:314 satisfyExistingPodsAntiAffinity): each term's owner
+    # pins one (key, value) pair; a node fails iff it shares that pair and
+    # the incoming pod matches the term — an [Et, B] x [Et, N] contraction
     ft = cluster.filter_terms
     em = pre.em  # [Et, B]
-    pod_topo = jnp.take(cluster.topo_pair, jnp.clip(cluster.pod_node, 0, None), axis=0)
-    e_pair = jnp.take_along_axis(pod_topo[jnp.clip(ft.pod_idx, 0, None)],
+    e_pair = jnp.take_along_axis(pod_tp[jnp.clip(ft.pod_idx, 0, None)],
                                  ft.topo_key[:, None], axis=1)[:, 0]  # [Et]
-    owner_ok = jnp.take(cluster.pod_valid, jnp.clip(ft.pod_idx, 0, None))
+    owner_ok = (jnp.take(cluster.pod_valid, jnp.clip(ft.pod_idx, 0, None))
+                & (jnp.take(cluster.pod_node,
+                            jnp.clip(ft.pod_idx, 0, None)) >= 0))
     e_pair = jnp.where(ft.valid & owner_ok, e_pair, -1)
-    ids = jnp.where(e_pair >= 0, e_pair, L)
-    counts_lb = jax.ops.segment_sum(_f(em), ids, num_segments=L + 1)[:L]  # [L, B]
-    exist_fail = jnp.einsum("bl,nl->bn", counts_lb.T, _f(cluster.kv),
+    node_pairs_e = jnp.take(cluster.topo_pair.T, ft.topo_key, axis=0)  # [Et, N]
+    sp_rows = (node_pairs_e == e_pair[:, None]) & (e_pair >= 0)[:, None]
+    exist_fail = jnp.einsum("eb,en->bn", em.astype(jnp.bfloat16),
+                            sp_rows.astype(jnp.bfloat16),
                             preferred_element_type=jnp.float32) > 0.5
 
     ok = aff_ok & ~anti_fail & ~exist_fail
@@ -450,9 +554,13 @@ def interpod_score_pre(cluster, batch) -> InterpodScorePre:
 
 def interpod_score(cluster, batch, feasible,
                    pre: InterpodScorePre | None = None) -> jnp.ndarray:
-    """InterPodAffinity scoring, already normalized (reference: scoring.go)."""
+    """InterPodAffinity scoring, already normalized (reference: scoring.go).
+
+    Node-space formulation: the (topologyKey, value) -> weight map becomes
+    per-node weighted same-pair sums — MXU matmuls with bf16-exact inputs
+    (weights are ints |w| <= 100; accumulation is f32)."""
     B = batch.req.shape[0]
-    L = cluster.kv.shape[1]
+    N = cluster.allocatable.shape[0]
     if pre is None:
         pre = interpod_score_pre(cluster, batch)
 
@@ -460,29 +568,35 @@ def interpod_score(cluster, batch, feasible,
     pt = batch.pref
     T = pt.valid.shape[1]
     m = _pod_term_matches(cluster, pt, B, pre=pre.m_pref)  # [B, T, P]
-    ep_pair = pod_topo_pairs(cluster, pt.topo_key.reshape(-1))  # [B*T, P]
     data = (_f(m) * pt.weight[:, :, None] * _f(pt.valid)[:, :, None])
-    counts = pair_scatter(data.reshape(B * T, -1), ep_pair, L)
-    counts = jnp.sum(counts.reshape(B, T, L), axis=1)  # [B, L]
+    keys_p = jnp.where(pt.topo_known, pt.topo_key, -1).reshape(-1)
+    raw1 = _samepair_pods_to_nodes(cluster, data.reshape(B * T, -1), keys_p,
+                                   cluster.pod_node, cluster.pod_valid)
+    raw1 = jnp.sum(raw1.reshape(B, T, N), axis=1)  # [B, N]
 
-    # existing pods' terms vs incoming pod
+    # existing pods' terms vs incoming pod: each term pins its owner-node's
+    # (key, value) pair; nodes sharing it receive the term weight
     st = cluster.score_terms
-    owner_ok = jnp.take(cluster.pod_valid, jnp.clip(st.pod_idx, 0, None))
-    em = _f(pre.em & owner_ok[:, None]) * st.weight[:, None]
+    owner_ok = (jnp.take(cluster.pod_valid, jnp.clip(st.pod_idx, 0, None))
+                & (jnp.take(cluster.pod_node,
+                            jnp.clip(st.pod_idx, 0, None)) >= 0))
+    em = _f(pre.em & owner_ok[:, None]) * st.weight[:, None]  # [Es, B]
     pod_topo = jnp.take(cluster.topo_pair, jnp.clip(cluster.pod_node, 0, None), axis=0)
     e_pair = jnp.take_along_axis(pod_topo[jnp.clip(st.pod_idx, 0, None)],
                                  st.topo_key[:, None], axis=1)[:, 0]
     e_pair = jnp.where(st.valid & owner_ok, e_pair, -1)
-    ids = jnp.where(e_pair >= 0, e_pair, L)
-    counts2 = jax.ops.segment_sum(em, ids, num_segments=L + 1)[:L].T  # [B, L]
-    counts = counts + counts2
+    node_pairs_e = jnp.take(cluster.topo_pair.T, st.topo_key, axis=0)  # [Es, N]
+    sp_rows = (node_pairs_e == e_pair[:, None]) & (e_pair >= 0)[:, None]
+    raw2 = jnp.einsum("eb,en->bn", em.astype(jnp.bfloat16),
+                      sp_rows.astype(jnp.bfloat16),
+                      preferred_element_type=jnp.float32)
 
-    raw = jnp.einsum("bl,nl->bn", counts, _f(cluster.kv),
-                     preferred_element_type=jnp.float32)
+    raw = raw1 + raw2
 
     # NormalizeScore (scoring.go:237-271): min/max start at 0; skip entirely
-    # when the topologyScore map is empty
-    any_counts = jnp.any(counts != 0, axis=1, keepdims=True)
+    # when the topologyScore map is empty.  Every counted pair lives on at
+    # least its owner's node, so "map empty" == "raw zero at every node".
+    any_counts = jnp.any(raw != 0, axis=1, keepdims=True)
     big = jnp.float32(2**62)
     max_c = jnp.maximum(jnp.max(jnp.where(feasible, raw, -big), axis=1,
                                 keepdims=True), 0.0)
@@ -529,8 +643,11 @@ def balanced_formula(req_cpu, req_mem, alloc_cpu, alloc_mem) -> jnp.ndarray:
     diff = jnp.abs(cpu_frac - mem_frac)
     # the reference truncates a float64 product (balanced_allocation.go:103);
     # two f32 divisions can land an ulp under the true value (e.g.
-    # 74.999997 for a true 75), so compensate before the floor
-    score = jnp.floor((1.0 - diff) * MAX_NODE_SCORE + 1e-4)
+    # 74.999997 for a true 75), so compensate before the floor.  The
+    # epsilon must stay at ulp scale (~7.6e-6 at score 75): anything
+    # larger would round UP true products legitimately within epsilon
+    # below an integer, diverging from the reference's floor
+    score = jnp.floor((1.0 - diff) * MAX_NODE_SCORE + 1e-5)
     return jnp.where((cpu_frac >= 1.0) | (mem_frac >= 1.0), 0.0, score)
 
 
@@ -650,8 +767,10 @@ def default_spread_normalize(cluster, batch, raw, feasible) -> jnp.ndarray:
     max_node = jnp.maximum(max_node, 0.0)
 
     zid = jnp.where((cluster.zone_id >= 0) & cluster.node_valid, cluster.zone_id, Z)
-    counts_by_zone = jax.ops.segment_sum(raw_f.T, zid, num_segments=Z + 1)[:Z]  # [Z, B]
-    counts_by_zone = counts_by_zone.T  # [B, Z]
+    zone_oh = (zid[:, None] == jnp.arange(Z)[None, :])  # [N, Z]
+    counts_by_zone = jnp.einsum("bn,nz->bz", raw_f, zone_oh.astype(raw_f.dtype),
+                                precision=jax.lax.Precision.HIGHEST,
+                                preferred_element_type=jnp.float32)  # [B, Z]
     have_zone_node = feasible & (cluster.zone_id >= 0)[None, :]
     have_zones = jnp.any(have_zone_node, axis=1, keepdims=True)
     max_zone = jnp.maximum(jnp.max(counts_by_zone, axis=1, keepdims=True), 0.0)

@@ -15,6 +15,7 @@ for volume-less pods, so the TPU fast path is untouched.
 
 from __future__ import annotations
 
+import re
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..api import types as api
@@ -309,6 +310,7 @@ class _NonCSILimits(_VolumePlugin, fw.FilterPlugin):
     NAME = ""
     LIMIT_KEY = ""       # volumeutil.*VolumeLimitKey
     DEFAULT_LIMIT = 0
+    PROVISIONER = ""     # in-tree provisioner this filter owns
 
     def _source(self, v) -> Optional[str]:
         raise NotImplementedError
@@ -317,24 +319,50 @@ class _NonCSILimits(_VolumePlugin, fw.FilterPlugin):
         return any(self._source(v) or v.persistent_volume_claim
                    for v in pod.spec.volumes)
 
+    def _match_provisioner(self, pvc: api.PersistentVolumeClaim) -> bool:
+        """Does this PVC's StorageClass belong to the running filter?
+        (reference: non_csi.go:328 matchProvisioner — nil StorageClassName
+        or a missing class both mean NO)."""
+        if not pvc.storage_class_name or self.store is None:
+            return False
+        sc = self.store.get_storage_class(pvc.storage_class_name)
+        return sc is not None and sc.provisioner == self.PROVISIONER
+
     def _count(self, pod: api.Pod, out: Set[str]) -> None:
+        """reference: non_csi.go:272 filterVolumes — an unresolvable PVC is
+        counted ONLY when its StorageClass provisioner matches this filter's
+        type; a PVC that cannot be looked up at all is never counted."""
         for v in pod.spec.volumes:
             src = self._source(v)
             if src:
                 out.add(src)
-            elif v.persistent_volume_claim:
-                pvc = (self.store.get_pvc(pod.namespace,
-                                          v.persistent_volume_claim)
-                       if self.store else None)
-                pv = self._pv(pvc.volume_name) if pvc else None
-                if pv is None:
-                    # unbound/missing claim: assume this type
-                    # (non_csi.go:230-246)
-                    out.add(f"{pod.namespace}/{v.persistent_volume_claim}")
-                else:
-                    src = self._source(pv)
-                    if src:
-                        out.add(src)
+                continue
+            if not v.persistent_volume_claim:
+                continue
+            pvc = (self.store.get_pvc(pod.namespace,
+                                      v.persistent_volume_claim)
+                   if self.store else None)
+            if pvc is None:
+                # no guarantee the claim belongs to this predicate
+                # (non_csi.go:287-291)
+                continue
+            pv_id = f"{pod.namespace}/{v.persistent_volume_claim}"
+            if not pvc.volume_name:
+                # unbound claim: counted iff its class provisions this type
+                # (non_csi.go:294-303)
+                if self._match_provisioner(pvc):
+                    out.add(pv_id)
+                continue
+            pv = self._pv(pvc.volume_name)
+            if pv is None:
+                # bound to a deleted PV: same provisioner rule
+                # (non_csi.go:306-314)
+                if self._match_provisioner(pvc):
+                    out.add(pv_id)
+                continue
+            src = self._source(pv)
+            if src:
+                out.add(src)
 
     def filter(self, state: CycleState, pod: api.Pod, node_info) -> Status:
         new: Set[str] = set()
@@ -362,17 +390,41 @@ class _NonCSILimits(_VolumePlugin, fw.FilterPlugin):
                 return int(env)
             except ValueError:
                 pass
+        return self._default_limit(node)
+
+    def _default_limit(self, node) -> int:
         return self.DEFAULT_LIMIT
 
 
+# reference: pkg/volume/util/attach_limit.go:30-37.  Go's
+# regexp.MatchString is an unanchored SEARCH (only the first alternative
+# carries an explicit ^) — compiled once, used with .search()
+EBS_NITRO_LIMIT_REGEX = re.compile(r"^[cmr]5.*|t3|z1d")
+DEFAULT_MAX_EBS_NITRO_VOLUME_LIMIT = 25
+LABEL_INSTANCE_TYPE = "beta.kubernetes.io/instance-type"
+LABEL_INSTANCE_TYPE_STABLE = "node.kubernetes.io/instance-type"
+
+
 class EBSLimits(_NonCSILimits):
-    """reference: non_csi.go:86 EBSName; default 39 (non_csi.go:41)."""
+    """reference: non_csi.go:86 EBSName; default 39 (non_csi.go:41), 25 on
+    Nitro instance types (non_csi.go:509 getMaxEBSVolume)."""
     NAME = "EBSLimits"
     LIMIT_KEY = "attachable-volumes-aws-ebs"
     DEFAULT_LIMIT = 39
+    PROVISIONER = "kubernetes.io/aws-ebs"
 
     def _source(self, v):
         return v.aws_elastic_block_store
+
+    def _default_limit(self, node) -> int:
+        itype = ""
+        if node is not None:
+            labels = node.metadata.labels
+            itype = (labels.get(LABEL_INSTANCE_TYPE)
+                     or labels.get(LABEL_INSTANCE_TYPE_STABLE) or "")
+        if itype and EBS_NITRO_LIMIT_REGEX.search(itype):
+            return DEFAULT_MAX_EBS_NITRO_VOLUME_LIMIT
+        return self.DEFAULT_LIMIT
 
 
 class GCEPDLimits(_NonCSILimits):
@@ -380,6 +432,7 @@ class GCEPDLimits(_NonCSILimits):
     NAME = "GCEPDLimits"
     LIMIT_KEY = "attachable-volumes-gce-pd"
     DEFAULT_LIMIT = 16
+    PROVISIONER = "kubernetes.io/gce-pd"
 
     def _source(self, v):
         return v.gce_persistent_disk
@@ -390,6 +443,7 @@ class AzureDiskLimits(_NonCSILimits):
     NAME = "AzureDiskLimits"
     LIMIT_KEY = "attachable-volumes-azure-disk"
     DEFAULT_LIMIT = 16
+    PROVISIONER = "kubernetes.io/azure-disk"
 
     def _source(self, v):
         return v.azure_disk
@@ -401,6 +455,7 @@ class CinderLimits(_NonCSILimits):
     NAME = "CinderLimits"
     LIMIT_KEY = "attachable-volumes-cinder"
     DEFAULT_LIMIT = 256
+    PROVISIONER = "kubernetes.io/cinder"
 
     def _source(self, v):
         return v.cinder

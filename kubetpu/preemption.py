@@ -186,15 +186,6 @@ class CycleContext:
             self._min_prio = min(prios) if prios else None
         return self._min_prio
 
-    def ensure_fresh(self) -> None:
-        """Refresh the shared verdicts if any commit landed since they were
-        taken (no-op when they are already current)."""
-        if self.batch is None:
-            return
-        self._materialize_lazy()
-        if self.feasible is None or self._verdict_commits != self.commits:
-            self.refresh_verdicts()
-
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def _whatif_reprieve(cluster, batch1, cfg, cand_rows, rm_valid, rm_req,

@@ -136,6 +136,11 @@ class Scheduler:
             from .parallel import mesh as pmesh
             self._mesh = pmesh.make_mesh(tuple(self.config.mesh_shape))
         self._jax = jax
+        # cumulative wall time spent blocked on the per-cycle packed
+        # readback — the only point where device completion is observable
+        # (block_until_ready does not block through the axon tunnel);
+        # benchmarks read this for the honest host/device split
+        self.device_wait_s = 0.0
         self._async_binding = async_binding
         self._bind_pool = ThreadPoolExecutor(max_workers=16,
                                              thread_name_prefix="binder")
@@ -401,11 +406,16 @@ class Scheduler:
         B = batch.valid.shape[0]
         N = cluster.allocatable.shape[0]
 
-        # ---- host filter plugins -> mask fed into the device program
+        # ---- host filter plugins -> mask fed into the device program.
+        # Relevance is computed ONCE per pod per cycle and reused by the
+        # commit-time re-check (it walks every host plugin's relevance
+        # predicate — measurable at 4k pods/cycle).
+        host_relevant = {qp.pod.uid: fwk.has_relevant_host_filters(qp.pod)
+                         for qp in live}
         host_ok = np.ones((B, N), bool)
         any_host = False
         for i, qp in enumerate(live):
-            if not fwk.has_relevant_host_filters(qp.pod):
+            if not host_relevant[qp.pod.uid]:
                 continue
             any_host = True
             state = states[qp.pod.uid]
@@ -494,11 +504,20 @@ class Scheduler:
                     host_ok=self._jax.numpy.asarray(host_ok) if any_host
                     else None,
                     start_index=start)
-            self._next_start_node_index = int(res.next_start)
-        chosen_full = np.asarray(res.chosen)
+        # ONE device->host readback per cycle: the packed [3B(+1)] i32 view
+        # (chosen | n_feasible | all_unresolvable | seq: next_start).  The
+        # tunnel pays ~100 ms latency per transfer, so everything the host
+        # needs rides one small array; the big tensors (requested, masks)
+        # stay on device for chaining / lazy preemption verdicts.
+        t_dev = time.time()
+        packed = np.asarray(res.packed)
+        self.device_wait_s += time.time() - t_dev
+        chosen_full = packed[:B]
+        if self.config.mode != "gang":
+            self._next_start_node_index = int(packed[3 * B])
         chosen = chosen_full[:len(live)]
-        n_feas = np.asarray(res.n_feasible)[:len(live)]
-        unres = np.asarray(res.all_unresolvable)[:len(live)]
+        n_feas = packed[B:2 * B][:len(live)]
+        unres = packed[2 * B:3 * B][:len(live)].astype(bool)
         trace.step("Computing predicates and priorities on device done")
 
         # ---- commit each placement in scan order; failures DEFER until
@@ -517,7 +536,8 @@ class Scheduler:
                 continue
             node_name = node_infos[int(chosen[i])].node_name
             outcome = self._commit(fwk, qp, state, node_name,
-                                   int(n_feas[i]))
+                                   int(n_feas[i]), pinfo=pinfos[i],
+                                   host_relevant=host_relevant[qp.pod.uid])
             if outcome.node:
                 # preemption for pods failing later in this batch must see
                 # this placement (CycleContext.cluster_now overlay)
@@ -552,7 +572,7 @@ class Scheduler:
             ta = batch.raa.valid.shape[1]
             e_next = int(cluster.filter_terms.valid.shape[0]) + B_cap * ta
             next_cluster = materialize_assigned(
-                cluster, batch, self._jax.numpy.asarray(chosen_full),
+                cluster, batch, res.chosen,
                 res.requested, res.nz, res.ports_used,
                 pad_pods_to=pow2_bucket(p_next),
                 pad_terms_to=pow2_bucket(e_next),
@@ -712,8 +732,11 @@ class Scheduler:
 
     def _commit(self, fwk: Framework, qp: QueuedPodInfo, state: CycleState,
                 node_name: str, n_feasible: int,
-                binder_override=None) -> ScheduleOutcome:
+                binder_override=None, pinfo: Optional[PodInfo] = None,
+                host_relevant: Optional[bool] = None) -> ScheduleOutcome:
         pod = qp.pod
+        if host_relevant is None:
+            host_relevant = fwk.has_relevant_host_filters(pod)
         # Commit-time host-filter re-check: the pre-batch host_ok mask was
         # computed before any same-batch pod was assumed, so two same-batch
         # pods could exceed a host-checked per-node limit (e.g. attachable
@@ -721,7 +744,7 @@ class Scheduler:
         # includes earlier same-batch assumes — before reserving.  The
         # reference's serial loop gets this by construction
         # (scheduler.go:509: every pod filters against assumed state).
-        if fwk.has_relevant_host_filters(pod):
+        if host_relevant:
             ni = self.cache.node_info(node_name)
             if ni is not None:
                 st = fwk.run_filter_plugins(state, pod, ni)
@@ -749,7 +772,9 @@ class Scheduler:
         assumed.spec = copy.copy(pod.spec)
         assumed.spec.node_name = node_name
         try:
-            self.cache.assume_pod(assumed)
+            self.cache.assume_pod(
+                assumed,
+                pinfo.with_pod(assumed) if pinfo is not None else None)
         except ValueError as e:
             fwk.run_unreserve_plugins(state, pod, node_name)
             return self._fail(fwk, qp, state, node_name, str(e),

@@ -136,13 +136,14 @@ class SchedulerCache:
 
     # -- pods ---------------------------------------------------------------
 
-    def assume_pod(self, pod: api.Pod) -> None:
-        """reference: cache.go:338 AssumePod."""
+    def assume_pod(self, pod: api.Pod, pinfo=None) -> None:
+        """reference: cache.go:338 AssumePod.  pinfo: optional pre-parsed
+        PodInfo wrapping this pod (hot-path callers avoid a re-parse)."""
         with self._lock:
             if pod.uid in self.pod_states:
                 raise ValueError(f"pod {pod.uid} is in the cache, "
                                  "so can't be assumed")
-            self._add_pod(pod)
+            self._add_pod(pod, pinfo)
             self.pod_states[pod.uid] = _PodState(pod=pod)
             self.assumed_pods[pod.uid] = True
 
@@ -218,9 +219,9 @@ class SchedulerCache:
         with self._lock:
             return bool(self.assumed_pods.get(pod.uid))
 
-    def _add_pod(self, pod: api.Pod) -> None:
+    def _add_pod(self, pod: api.Pod, pinfo=None) -> None:
         item = self._node_item(pod.spec.node_name)
-        item.info.add_pod(pod)
+        item.info.add_pod(pod, pinfo)
         self._move_to_head(item)
 
     def _remove_pod(self, pod: api.Pod) -> None:

@@ -14,6 +14,7 @@ objects into it; aggregation semantics match the reference's defaults
 
 from __future__ import annotations
 
+import copy
 import threading
 import time
 from dataclasses import dataclass, field
@@ -111,6 +112,11 @@ class EventBroadcaster:
                 ev.count += 1
                 ev.last_timestamp = now
                 ev.message = message
+                # watchers and the sink get an immutable SNAPSHOT taken
+                # under the lock: the cached Event keeps mutating on
+                # aggregation, and handing out the live object would let
+                # concurrent recorders expose torn count/message reads
+                ev = copy.copy(ev)
                 if self.sink is not None:
                     try:
                         self.sink.update(ev)
@@ -133,6 +139,9 @@ class EventBroadcaster:
                 # keys simply start a fresh Event on their next repeat
                 while len(self._cache) > self._max:
                     self._cache.popitem(last=False)
+                # same immutable-snapshot rule: the cached instance will
+                # mutate on future aggregations
+                ev = copy.copy(ev)
                 if self.sink is not None:
                     try:
                         self.sink.add(ev)

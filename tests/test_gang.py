@@ -303,10 +303,10 @@ def test_self_affinity_gang_converges_in_few_rounds():
     assert int(g.rounds) <= 4, int(g.rounds)
 
 
-def test_run_auction_replays_monolithic_loop():
-    # The two-phase residual auction must reproduce the monolithic
-    # while_loop's placements bit-for-bit on a contended topology workload
-    # (same tie-break streams, same admission order, same committed state).
+def test_packed_host_view_matches_fields():
+    # The packed [3B] i32 array is the serving loop's ONLY per-cycle
+    # readback — it must stay consistent with the individual result
+    # fields on a contended topology workload.
     from kubetpu.harness import hollow
     nodes = [mknode(name=f"n{i}", labels={
         api.LABEL_HOSTNAME: f"n{i}", api.LABEL_ZONE: f"z{i % 2}"})
@@ -321,19 +321,21 @@ def test_run_auction_replays_monolithic_loop():
         pending.append(p)
     cluster, batch, cfg, _ = build(nodes, {}, pending, filters=TOPO_FILTERS)
     rng = jax.random.PRNGKey(3)
-    mono = gang.schedule_gang(cluster, batch, cfg, rng)
-    two = gang.run_auction(cluster, batch, cfg, rng)
-    assert np.array_equal(np.asarray(two.chosen)[:18],
-                          np.asarray(mono.chosen)[:18])
-    assert np.allclose(np.asarray(two.requested),
-                       np.asarray(mono.requested))
+    res = gang.run_auction(cluster, batch, cfg, rng)
+    B = batch.valid.shape[0] if batch.valid.ndim else 0
+    packed = np.asarray(res.packed)
+    assert packed.shape == (3 * B,)
+    assert np.array_equal(packed[:B], np.asarray(res.chosen))
+    assert np.array_equal(packed[B:2 * B], np.asarray(res.n_feasible))
+    assert np.array_equal(packed[2 * B:].astype(bool),
+                          np.asarray(res.all_unresolvable))
 
 
 def test_adversarial_contention_bounded_rounds():
     """Worst-case contention (every pod scores every node identically, one
-    slot per node): the two-phase auction still terminates with zero
-    capacity violations, and the residual phase — not B full-batch
-    rounds — absorbs the serialization (VERDICT r2 weak #6)."""
+    slot per node): the auction's propose/admit while_loop terminates with
+    zero capacity violations in rounds bounded by the contended pod count
+    (VERDICT r2 weak #6)."""
     nodes = [mknode(name=f"n{i}", pods="1") for i in range(4)]
     pending = [mkpod(name=f"p{i:02d}") for i in range(16)]
     cluster, batch, cfg, _ = build(nodes, {}, pending, scores=())

@@ -64,8 +64,23 @@ def deleted_pvc_pod():
     return pod_with([pvc("deletedPVC")], name="delpvc")
 
 
+def ebs_store():
+    """Reference fixture shape (non_csi_test.go:1225 getFakePVCLister +
+    getFakeCSIStorageClassLister): the 'deleted' PVCs EXIST in the lister,
+    bound to PVs that are gone, with a StorageClass whose provisioner
+    matches the filter — that is what makes them count."""
+    store = ClusterStore()
+    store.add(api.StorageClass(metadata=api.ObjectMeta(name="ebs-sc"),
+                               provisioner="kubernetes.io/aws-ebs"))
+    for name in ("deletedPVC", "anotherDeletedPVC", "newPVC"):
+        store.add(api.PersistentVolumeClaim(
+            metadata=api.ObjectMeta(name=name),
+            volume_name=f"{name}-pv-gone", storage_class_name="ebs-sc"))
+    return store
+
+
 def run_ebs(new_pod, existing, max_vols, store=None):
-    p = volumes.EBSLimits(store=store or ClusterStore())
+    p = volumes.EBSLimits(store=store or ebs_store())
     ni = node_info(max_vols, "attachable-volumes-aws-ebs", existing)
     return p.filter(CycleState(), new_pod, ni)
 
@@ -112,6 +127,47 @@ class TestEBSLimits:
         st = run_ebs(pod_with([pvc("newPVC")], name="newpvc"),
                      [two_deleted], max_vols=2)
         assert not st.is_success()
+
+    def test_unknown_pvc_not_counted(self):
+        # non_csi.go:287-291 — a PVC the lister cannot resolve gives no
+        # guarantee it belongs to this predicate, so it is NOT counted
+        st = run_ebs(pod_with([pvc("no-such-claim")], name="ghost"),
+                     [one_vol(), one_vol()], max_vols=1,
+                     store=ClusterStore())
+        assert st.is_success()
+
+    def test_unmatched_provisioner_not_counted(self):
+        # non_csi.go:328 matchProvisioner — an unbound PVC whose class
+        # provisions a DIFFERENT type never consumes an EBS slot
+        store = ClusterStore()
+        store.add(api.StorageClass(metadata=api.ObjectMeta(name="csi-sc"),
+                                   provisioner="ebs.csi.aws.com"))
+        store.add(api.PersistentVolumeClaim(
+            metadata=api.ObjectMeta(name="csiPVC"),
+            storage_class_name="csi-sc"))
+        st = run_ebs(pod_with([pvc("csiPVC")], name="csi"),
+                     [one_vol()], max_vols=1, store=store)
+        assert st.is_success()
+
+    def test_no_storage_class_not_counted(self):
+        # matchProvisioner: nil StorageClassName => false
+        store = ClusterStore()
+        store.add(api.PersistentVolumeClaim(
+            metadata=api.ObjectMeta(name="classless")))
+        st = run_ebs(pod_with([pvc("classless")], name="cl"),
+                     [one_vol()], max_vols=1, store=store)
+        assert st.is_success()
+
+    def test_nitro_instance_default_limit(self):
+        # non_csi.go:509 getMaxEBSVolume + attach_limit.go:30-37: Nitro
+        # instance types default to 25, not 39
+        p = volumes.EBSLimits(store=ClusterStore())
+        n = mknode(name="nitro")
+        n.metadata.labels["node.kubernetes.io/instance-type"] = "m5.large"
+        assert p._max_volumes(NodeInfo(n)) == 25
+        n2 = mknode(name="classic")
+        n2.metadata.labels["node.kubernetes.io/instance-type"] = "m4.large"
+        assert p._max_volumes(NodeInfo(n2)) == 39
 
     def test_pvc_backed_by_ebs_counts(self):
         # "new pod's count considers PVCs backed by EBS volumes"
