@@ -275,6 +275,14 @@ def rescore_case(n_pods=102400, n_nodes=10240, chunk=16384):
         placed += int((packed[:chunk] >= 0).sum())
     dt = time.time() - t0
     mem = jax.local_devices()[0].memory_stats() or {}
+    # the axon runtime exposes no memory_stats; fall back to an analytic
+    # footprint: resident cluster + batch tensors plus the program's
+    # dominant [B, N] f32 transients (feasible/unresolvable/scores/ties)
+    def tree_bytes(t):
+        return int(sum(x.nbytes for x in jax.tree.leaves(t)
+                       if hasattr(x, "nbytes")))
+    resident = tree_bytes(cluster) + tree_bytes(batch)
+    transient = 6 * chunk * cluster.allocatable.shape[0] * 4
     sched.close()
     return {
         "pods": n_pods, "nodes": n_nodes, "chunk": chunk,
@@ -282,7 +290,8 @@ def rescore_case(n_pods=102400, n_nodes=10240, chunk=16384):
         "pods_per_sec": round(n_pods / dt, 1),
         "placed_per_chunk": placed // n_chunks,
         "hbm_peak_bytes": int(mem.get("peak_bytes_in_use", 0)),
-        "hbm_in_use_bytes": int(mem.get("bytes_in_use", 0)),
+        "hbm_resident_est_bytes": resident,
+        "hbm_transient_est_bytes": transient,
     }
 
 
@@ -326,25 +335,8 @@ def main() -> None:
         if headline is None:
             headline = (mode, pods_per_sec)
 
-    if os.environ.get("BENCH_CHAIN_DRAIN", "1") == "1" and mesh_shape is None:
-        detail["chain_drain"] = chain_drain_case(n_nodes, n_pods,
-                                                 existing_per_node)
-
-    if full:
-        northstar = {}
-        best, first, outcomes, sched, stats = run_mode(
-            "gang", 5120, 10240, 1, repeats=1, batch_cap=10240,
-            ipa_heavy=True)
-        d, pods_per_sec = mode_summary("gang", best, first, outcomes, sched,
-                                       stats)
-        d["pods_per_sec"] = round(pods_per_sec, 1)
-        sched.close()
-        northstar["e2e_gang_10240x5120_ipa_heavy"] = d
-        northstar["rescore_100kx10k"] = rescore_case()
-        detail["northstar"] = northstar
-        with open("NORTHSTAR.json", "w") as f:
-            json.dump(northstar, f, indent=1)
-
+    # the headline prints BEFORE the optional extra cases: a failure at an
+    # experimental scale must never cost the recorded number
     mode, pods_per_sec = headline
     baseline = 30.0  # reference hard throughput floor (scheduler_test.go:40)
     print(json.dumps({
@@ -352,7 +344,40 @@ def main() -> None:
         "value": round(pods_per_sec, 1),
         "unit": "pods/s",
         "vs_baseline": round(pods_per_sec / baseline, 2),
-    }))
+    }), flush=True)
+
+    if os.environ.get("BENCH_CHAIN_DRAIN", "1") == "1" and mesh_shape is None:
+        try:
+            detail["chain_drain"] = chain_drain_case(n_nodes, n_pods,
+                                                     existing_per_node)
+        except Exception as e:  # pragma: no cover - depends on device state
+            detail["chain_drain"] = {"error": repr(e)}
+
+    if full:
+        northstar = {}
+        try:
+            # 10k x 5k InterPodAffinity-heavy, drained in chained 4096-pod
+            # cycles — single 10k-pod programs exceed the chip's program/
+            # memory envelope, and the multi-cycle drain is the serving
+            # loop's real shape anyway
+            best, first, outcomes, sched, stats = run_mode(
+                "gang", 5120, 10240, 1, repeats=1, batch_cap=4096,
+                ipa_heavy=True)
+            d, pods_per_sec = mode_summary("gang", best, first, outcomes,
+                                           sched, stats)
+            d["pods_per_sec"] = round(pods_per_sec, 1)
+            sched.close()
+            northstar["e2e_gang_10240x5120_ipa_heavy"] = d
+        except Exception as e:  # pragma: no cover
+            northstar["e2e_gang_10240x5120_ipa_heavy"] = {"error": repr(e)}
+        try:
+            northstar["rescore_100kx10k"] = rescore_case()
+        except Exception as e:  # pragma: no cover
+            northstar["rescore_100kx10k"] = {"error": repr(e)}
+        detail["northstar"] = northstar
+        with open("NORTHSTAR.json", "w") as f:
+            json.dump(northstar, f, indent=1)
+
     print(json.dumps({"detail": detail}), file=sys.stderr)
 
 

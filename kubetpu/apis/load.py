@@ -100,8 +100,19 @@ def apply_defaults(cfg: KubeSchedulerConfiguration) -> None:
         cfg.batch_size = 256
 
 
-def validate(cfg: KubeSchedulerConfiguration) -> None:
-    """reference: validation/validation.go ValidateKubeSchedulerConfiguration."""
+def validate(cfg: KubeSchedulerConfiguration,
+             registry_names=None) -> None:
+    """reference: validation/validation.go
+    ValidateKubeSchedulerConfiguration (+ the plugin-existence and
+    queue-sort checks the reference performs at framework build time,
+    framework.go:205 NewFramework; VERDICT r3 #10).
+
+    registry_names: known plugin names for plugin-EXISTENCE checks.  When
+    None (config load time), existence is NOT checked — out-of-tree
+    plugins are resolvable only once the merged registry exists, so the
+    Scheduler re-validates with its actual registry at construction (the
+    reference likewise rejects unknown plugins at framework build time,
+    framework.go:205, not at config decode)."""
     errs: List[str] = []
     if not (0 <= cfg.percentage_of_nodes_to_score <= 100):
         errs.append("percentageOfNodesToScore must be in [0, 100]")
@@ -114,14 +125,69 @@ def validate(cfg: KubeSchedulerConfiguration) -> None:
     names = [p.scheduler_name for p in cfg.profiles]
     if len(set(names)) != len(names):
         errs.append("duplicate scheduler name in profiles")
+    known = None if registry_names is None else set(registry_names)
+    queue_sorts = set()
     for p in cfg.profiles:
+        hw = p.plugin_config.get("InterPodAffinity", {}) \
+            .get("hardPodAffinityWeight")
+        if hw is not None and not (0 <= int(hw) <= 100):
+            errs.append(f"profile {p.scheduler_name}: "
+                        "hardPodAffinityWeight must be in [0, 100]")
+        if known is not None:
+            for name in p.plugin_config:
+                if name not in known:
+                    errs.append(f"profile {p.scheduler_name}: pluginConfig "
+                                f"for unknown plugin {name!r}")
         if p.plugins is None:
+            queue_sorts.add(("PrioritySort",))   # the default queue sort
             continue
         for ep in EXTENSION_POINTS:
             ps: PluginSet = getattr(p.plugins, ep)
+            seen = set()
+            weight_total = 0
             for pl in ps.enabled:
-                if ep == "score" and pl.weight < 0:
-                    errs.append(f"plugin {pl.name}: negative weight")
+                if known is not None and pl.name != "*" \
+                        and pl.name not in known:
+                    errs.append(f"profile {p.scheduler_name}: unknown "
+                                f"plugin {pl.name!r} at {ep}")
+                if pl.name in seen:
+                    errs.append(f"profile {p.scheduler_name}: plugin "
+                                f"{pl.name!r} enabled twice at {ep}")
+                seen.add(pl.name)
+                if ep == "score":
+                    if pl.weight < 0:
+                        errs.append(f"plugin {pl.name}: negative weight")
+                    weight_total += max(pl.weight, 0)
+            # the reference guards int64 overflow of total weighted score
+            # (framework.go:638); our combine is exact-integer f32, so the
+            # cap is 2^24 / MaxNodeScore total weight
+            if ep == "score" and weight_total * 100 >= 2 ** 24:
+                errs.append(f"profile {p.scheduler_name}: total score "
+                            "weight too large (score sums would lose "
+                            "integer exactness)")
+            for pl in ps.disabled:
+                if known is not None and pl.name != "*" \
+                        and pl.name not in known:
+                    errs.append(f"profile {p.scheduler_name}: unknown "
+                                f"disabled plugin {pl.name!r} at {ep}")
+        queue_sorts.add(tuple(sorted(
+            pl.name for pl in p.plugins.queue_sort.enabled))
+            or ("PrioritySort",))
+    # all profiles must share one queue sort: there is ONE queue
+    # (reference: validation.go validateCommonQueueSort)
+    if len(queue_sorts) > 1:
+        errs.append("all profiles must use the same queueSort plugin set")
+    # extenders (reference: validation.go:129 validateExtenders)
+    binders = 0
+    for i, e in enumerate(cfg.extenders):
+        e = e if isinstance(e, dict) else vars(e)
+        if e.get("prioritizeVerb") and int(e.get("weight", 0)) <= 0:
+            errs.append(f"extender[{i}]: prioritizeVerb requires a "
+                        "positive weight")
+        if e.get("bindVerb"):
+            binders += 1
+    if binders > 1:
+        errs.append("only one extender can implement bind")
     if errs:
         raise ConfigError("; ".join(errs))
 

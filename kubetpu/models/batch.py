@@ -303,12 +303,14 @@ class PodBatchBuilder:
                                   if p.spec.node_selector else {})
             aff = p.spec.affinity
             na = aff.node_affinity if aff else None
-            if na and na.required_during_scheduling_ignored_during_execution:
-                rna_terms.append(list(
-                    na.required_during_scheduling_ignored_during_execution
-                    .node_selector_terms))
-            else:
-                rna_terms.append([])
+            # nil-vs-empty matters: a PRESENT required NodeSelector with an
+            # empty (or nil) terms list matches NO node (reference:
+            # helpers.go:180 MatchNodeSelectorTerms over zero terms), while
+            # an absent selector matches every node
+            rna = (na.required_during_scheduling_ignored_during_execution
+                   if na else None)
+            rna_terms.append(list(rna.node_selector_terms)
+                             if rna is not None else None)
             pna_terms.append(list(
                 na.preferred_during_scheduling_ignored_during_execution)
                 if na else [])
@@ -316,13 +318,15 @@ class PodBatchBuilder:
         node_selector = self.compiler.compile(
             node_selectors + [None] * (B - len(pods)), pad_s=B, intern_new=False)
 
-        Tn = pow2_bucket(max((len(x) for x in rna_terms), default=0), 1)
+        Tn = pow2_bucket(max((len(x) for x in rna_terms if x is not None),
+                             default=0), 1)
         rna_flat: List = []
         rna_valid = np.zeros((B, Tn), bool)
         has_rna = np.zeros((B,), bool)
         for i in range(B):
-            terms = rna_terms[i] if i < len(pods) else []
-            has_rna[i] = bool(terms)
+            terms = rna_terms[i] if i < len(pods) else None
+            has_rna[i] = terms is not None   # present selector, even empty
+            terms = terms or []
             for j in range(Tn):
                 if j < len(terms):
                     rna_flat.append(terms[j])

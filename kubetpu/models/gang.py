@@ -263,14 +263,32 @@ def run_auction(cluster, batch, cfg: ProgramConfig, rng,
                          intra_batch_topology=intra_batch_topology)
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("cfg", "max_rounds",
-                                    "intra_batch_topology"))
 def schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
                   host_ok: Optional[jnp.ndarray] = None,
                   max_rounds: Optional[int] = None,
                   intra_batch_topology: bool = True,
                   tie_index: Optional[jnp.ndarray] = None) -> GangResult:
+    """Python entry for the jitted auction.  The indirection is a REQUIRED
+    workaround for this runtime's jit dispatch: calling the jit object
+    directly from multiple call sites with different static-arg
+    combinations intermittently fails with 'Execution supplied N buffers
+    but compiled program expected N+1' (argument-pruning bookkeeping
+    crossing cache entries); routing every call through one Python frame
+    avoids the C++ fastpath state that triggers it."""
+    return _schedule_gang(cluster, batch, cfg, rng, host_ok=host_ok,
+                          max_rounds=max_rounds,
+                          intra_batch_topology=intra_batch_topology,
+                          tie_index=tie_index)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "max_rounds",
+                                    "intra_batch_topology"))
+def _schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
+                   host_ok: Optional[jnp.ndarray] = None,
+                   max_rounds: Optional[int] = None,
+                   intra_batch_topology: bool = True,
+                   tie_index: Optional[jnp.ndarray] = None) -> GangResult:
     from .batch import densify_for
     batch = densify_for(cluster, batch)
     B = batch.req.shape[0]

@@ -207,9 +207,11 @@ def run_scores(cluster, batch, cfg: ProgramConfig, feasible, affinity_ok,
             s = K.default_normalize(K.taint_toleration_score(cluster, batch),
                                     feasible, reverse=True)
         elif name == "RequestedToCapacityRatio":
+            # default shape already on the MaxNodeScore scale (the plugin
+            # rescales config scores x10 at construction, see intree.py)
             shape, resources = cfg.arg(
                 "RequestedToCapacityRatio",
-                (((0, 0), (100, 10)), ((0, 0, 1), (1, 0, 1))))
+                (((0, 0), (100, 100)), ((0, 0, 1), (1, 0, 1))))
             s = K.requested_to_capacity_ratio_score(cluster, batch, shape,
                                                     resources)
         elif name == "NodeResourceLimits":
@@ -286,6 +288,50 @@ def nominated_fit_mask(cluster, batch, nom):
     mask = jnp.ones((B, N), bool).at[:, rows].min(
         jnp.where(ok_entry[None, :], ok, True))
     return mask
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def nominated_topology_mask(cluster, nom_batch, nom_rows, nom_prio, batch,
+                            cfg: ProgramConfig):
+    """Topology dimension of addNominatedPods (generic_scheduler.go:530):
+    nominated pods become EXISTING pods placed on their nominated nodes —
+    labels, namespaces and required anti-affinity terms included — and the
+    batch re-runs its InterPodAffinity + PodTopologySpread filters against
+    that extended cluster.  ANDed with the overlay-free main pass this
+    reproduces the reference's two-pass rule for the topology dimension:
+    a nominated pod can REPEL or SKEW lower/equal-priority pods but never
+    satisfy their affinity (the without-pass still gates).
+
+    Per-row applicability (only nominated pods of >= priority are visible,
+    :536) is gated at row granularity: rows where NO nominated pod
+    qualifies pass untouched.  Rows where only a SUBSET qualifies see the
+    full overlay — a conservative (over-blocking) deviation, exact in the
+    common case where nominated pods outrank the whole batch.
+
+    Returns [B, N] bool."""
+    from .batch import densify_for
+    from .gang import _extend_cluster  # lazy: gang imports this module
+
+    batch = densify_for(cluster, batch)
+    nom_batch = densify_for(cluster, nom_batch)
+    ext = _extend_cluster(cluster, nom_batch)
+    M = nom_batch.valid.shape[0]
+    placed = nom_batch.valid & (nom_rows >= 0)
+    ext = ext._replace(
+        pod_node=jnp.concatenate([cluster.pod_node,
+                                  jnp.asarray(nom_rows, jnp.int32)]),
+        pod_valid=jnp.concatenate([cluster.pod_valid, placed]))
+    affinity_ok = K.node_affinity_filter(ext, batch)
+    ok = jnp.ones((batch.valid.shape[0], cluster.allocatable.shape[0]), bool)
+    if "PodTopologySpread" in cfg.filters:
+        ok = ok & K.spread_filter(ext, batch, affinity_ok)
+    if "InterPodAffinity" in cfg.filters:
+        ipa_ok, _ = K.interpod_filter(ext, batch)
+        ok = ok & ipa_ok
+    affected = jnp.any(placed[None, :]
+                       & (nom_prio[None, :] >= batch.priority[:, None]),
+                       axis=1)
+    return jnp.where(affected[:, None], ok, True)
 
 
 def select_host(scores: jnp.ndarray, feasible: jnp.ndarray,

@@ -86,10 +86,22 @@ def _batch_term_matches(terms, batch, B):
     return m.reshape(B * T, B)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=())
 def schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
                         hard_pod_affinity_weight: float = 1.0,
                         host_ok=None, start_index=0) -> SeqResult:
+    """Python entry for the jitted scan — same required dispatch-bug
+    workaround as gang.schedule_gang (one Python frame between callers and
+    the jit object; see that docstring)."""
+    return _schedule_sequential(
+        cluster, batch, cfg, rng,
+        hard_pod_affinity_weight=hard_pod_affinity_weight,
+        host_ok=host_ok, start_index=start_index)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=())
+def _schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
+                         hard_pod_affinity_weight: float = 1.0,
+                         host_ok=None, start_index=0) -> SeqResult:
     from .batch import densify_for
     batch = densify_for(cluster, batch)
     B = batch.req.shape[0]
@@ -270,7 +282,7 @@ def schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
                                           cfg.arg("NodeLabel", ((), (), ()))[2])
                        if "NodeLabel" in score_w else None)
     rtcr_args = (cfg.arg("RequestedToCapacityRatio",
-                         (((0, 0), (100, 10)), ((0, 0, 1), (1, 0, 1))))
+                         (((0, 0), (100, 100)), ((0, 0, 1), (1, 0, 1))))
                  if "RequestedToCapacityRatio" in score_w else None)
 
     # ---------------- scan ----------------

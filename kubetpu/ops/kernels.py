@@ -847,7 +847,10 @@ def rtcr_combine(parts, shape):
     total = None
     weight_sum = None
     for req, cap, weight in parts:
-        util = 100.0 - _idiv((cap - req) * 100.0, jnp.maximum(cap, 1.0))
+        # _safe_den, not maximum(cap, 1): sub-unit capacities (byte-scale
+        # memory after MiB conversion) must still divide by their true
+        # value — the cap<=0 case is redirected to the fallback below
+        util = 100.0 - _idiv((cap - req) * 100.0, _safe_den(cap))
         s = broken_linear(util, shape)
         s = jnp.where((cap <= 0) | (req > cap),
                       broken_linear(jnp.full_like(util, 100.0), shape), s)

@@ -92,6 +92,12 @@ class Scheduler:
         self.cache = SchedulerCache(
             expire_listener=lambda pod: self._mark_chain_dirty())
         registry = registry or new_in_tree_registry()
+        # plugin-EXISTENCE validation happens HERE, against the MERGED
+        # registry (out-of-tree plugins included) — the reference rejects
+        # unknown plugins at framework build time (framework.go:205);
+        # config load validates everything else
+        from .apis.load import validate as validate_config
+        validate_config(self.config, registry_names=set(registry))
 
         # one framework per profile (reference: profile/profile.go:59 Map)
         self.profiles: Dict[str, Framework] = {}
@@ -374,6 +380,10 @@ class Scheduler:
         # cycle's materialized tensors already ARE this snapshot (no
         # unaccounted event landed), so skip the full rebuild entirely
         pinfos = [PodInfo(qp.pod) for qp in live]
+        # nominated pods join the tensor world too (labels/terms for the
+        # addNominatedPods topology overlay) — their vocab must be interned
+        # before snapshot arrays are sized
+        nom_pinfos = [PodInfo(pod) for pod, _ in self.queue.all_nominated()]
         chain = self._chain
         use_chain = (chain is not None and chain["seq"] == chain_seq0
                      and self._chain_enabled(fwk)
@@ -381,7 +391,7 @@ class Scheduler:
                      and chain["n_nodes"] == n_nodes)
         if use_chain:
             builder = chain["builder"]
-            builder.intern_pending(pinfos)
+            builder.intern_pending(pinfos + nom_pinfos)
             if _vocab_caps(builder.table) != chain["caps"]:
                 use_chain = False   # vocab bucket overflow: rebuild
         if use_chain:
@@ -390,7 +400,7 @@ class Scheduler:
         else:
             builder = SnapshotBuilder(
                 hard_pod_affinity_weight=fwk.hard_pod_affinity_weight)
-            builder.intern_pending(pinfos)
+            builder.intern_pending(pinfos + nom_pinfos)
             host_arrays = builder.build(node_infos)
             cluster = host_arrays.to_device()
             chain_pod_uids = [pi.pod.uid for ni in node_infos
@@ -425,10 +435,17 @@ class Scheduler:
         # ---- nominated-pods two-pass overlay (addNominatedPods,
         # generic_scheduler.go:530,594-612): equal/higher-priority pods
         # nominated by preemption reserve their nominated nodes' capacity
-        nom_mask = self._nominated_overlay_mask(builder, cluster, batch,
-                                                live, node_infos)
+        # AND contribute topology terms (anti-affinity/spread).  The mask
+        # stays a DEVICE array — pulling a [B, N] bool through the tunnel
+        # would cost more than the whole device program
+        nom_mask = self._nominated_overlay_mask(fwk, builder, cluster,
+                                                batch, live, node_infos)
+        host_ok_dev = None
+        if any_host:
+            host_ok_dev = self._jax.numpy.asarray(host_ok)
         if nom_mask is not None:
-            host_ok &= nom_mask
+            host_ok_dev = (nom_mask if host_ok_dev is None
+                           else host_ok_dev & nom_mask)
             any_host = True
         cfg = programs.ProgramConfig(
             filters=fwk.tensor_filters, scores=fwk.tensor_scores,
@@ -454,7 +471,7 @@ class Scheduler:
         if self.extenders:
             return outcomes + self._schedule_with_extenders(
                 fwk, live, states, node_infos, cluster, batch, cfg,
-                host_ok if any_host else None, cycle_ctx)
+                host_ok_dev, cycle_ctx)
 
         # ---- device: one program for the whole group (scan or auction)
         if self.config.mode == "gang":
@@ -473,14 +490,13 @@ class Scheduler:
                 from .parallel import mesh as pmesh
                 res = pmesh.sharded_schedule_gang(
                     cluster, batch, cfg, self._next_rng(), self._mesh,
-                    host_ok=host_ok if any_host else None,
+                    host_ok=host_ok_dev,
                     intra_batch_topology=needs_topo)
             else:
                 from .models.gang import run_auction
                 res = run_auction(
                     cluster, batch, cfg, self._next_rng(),
-                    host_ok=self._jax.numpy.asarray(host_ok) if any_host
-                    else None,
+                    host_ok=host_ok_dev,
                     intra_batch_topology=needs_topo)
             # the auction already produced per-pod verdict rows; share them
             # lazily so preemption can skip its candidates pass without the
@@ -494,15 +510,14 @@ class Scheduler:
                     cluster, batch, cfg, self._next_rng(), self._mesh,
                     hard_pod_affinity_weight=float(
                         fwk.hard_pod_affinity_weight),
-                    host_ok=host_ok if any_host else None,
+                    host_ok=host_ok_dev,
                     start_index=start)
             else:
                 res = schedule_sequential(
                     cluster, batch, cfg, self._next_rng(),
                     hard_pod_affinity_weight=float(
                         fwk.hard_pod_affinity_weight),
-                    host_ok=self._jax.numpy.asarray(host_ok) if any_host
-                    else None,
+                    host_ok=host_ok_dev,
                     start_index=start)
         # ONE device->host readback per cycle: the packed [3B(+1)] i32 view
         # (chosen | n_feasible | all_unresolvable | seq: next_start).  The
@@ -679,15 +694,21 @@ class Scheduler:
             outcomes.append(outcome)
         return outcomes
 
-    def _nominated_overlay_mask(self, builder, cluster, batch, live,
+    def _nominated_overlay_mask(self, fwk, builder, cluster, batch, live,
                                 node_infos):
-        """[B, N] bool — False where a pod would not fit once
+        """[B, N] bool DEVICE array — False where a pod would not fit once
         equal-or-greater-priority NOMINATED pods are counted as running on
         their nominated nodes (reference: addNominatedPods,
         core/generic_scheduler.go:530; the overlay-free second pass is the
-        main filter program).  A nominated pod that is itself in the batch
-        reserves capacity against every OTHER row, never its own.  None
-        when no nominated pod is relevant."""
+        main filter program).  Covers BOTH dimensions of AddPod: resource
+        capacity (nominated_fit_mask) and topology terms — nominated pods'
+        labels and required anti-affinity repel, and their label counts
+        skew PodTopologySpread (nominated_topology_mask).  A nominated pod
+        that is itself in the batch reserves capacity against every OTHER
+        row, never its own; batch-member nominated pods are excluded from
+        the topology overlay (per-row self-exclusion is not expressible in
+        one pass — documented bounded deviation).  None when no nominated
+        pod is relevant."""
         from .models.batch import build_nominated
         uid_to_row = {qp.pod.uid: i for i, qp in enumerate(live)}
         node_row = {ni.node_name: j for j, ni in enumerate(node_infos)}
@@ -701,7 +722,40 @@ class Scheduler:
             return None
         nom = build_nominated(entries, builder.table)
         mask = programs.nominated_fit_mask(cluster, batch, nom)
-        return np.asarray(mask)
+
+        # topology overlay: only when the profile runs topology filters and
+        # some term could actually interact
+        topo_filters = {"InterPodAffinity", "PodTopologySpread"}
+        topo_entries = [(pi, row) for pi, row, sr in entries if sr < 0]
+        if topo_entries and (topo_filters & set(fwk.tensor_filters)):
+            from .framework.types import (pod_with_affinity,
+                                          pod_with_required_anti_affinity)
+            interacts = (
+                any(pod_with_affinity(qp.pod)
+                    or qp.pod.spec.topology_spread_constraints
+                    for qp in live)
+                or any(pod_with_required_anti_affinity(pi.pod)
+                       for pi, _ in topo_entries))
+            if interacts:
+                jnp = self._jax.numpy
+                nom_pb = PodBatchBuilder(builder.table).build(
+                    [pi for pi, _ in topo_entries])
+                nom_pb = self._jax.tree.map(np.asarray, nom_pb)
+                M = np.asarray(nom_pb.valid).shape[0]
+                rows = np.full((M,), -1, np.int32)
+                prio = np.zeros((M,), np.int32)
+                for i, (pi, row) in enumerate(topo_entries):
+                    rows[i] = row
+                    prio[i] = pi.pod.priority()
+                topo_mask = programs.nominated_topology_mask(
+                    cluster, nom_pb, jnp.asarray(rows), jnp.asarray(prio),
+                    batch, programs.ProgramConfig(
+                        filters=fwk.tensor_filters, scores=(),
+                        hostname_topokey=max(
+                            builder.table.topokey.get(api.LABEL_HOSTNAME),
+                            0)))
+                mask = mask & topo_mask
+        return mask
 
     @staticmethod
     def _fits_live(pod_res, view) -> bool:
@@ -917,10 +971,90 @@ class Scheduler:
 
     # ------------------------------------------------------------------ loop
 
+    def prewarm(self) -> bool:
+        """Compile the serving program for the CURRENT cluster shape before
+        the first pod arrives (VERDICT r3 #7: first-cycle compile was ~6
+        cycles of latency).  Builds the real snapshot plus a synthetic
+        full-bucket pod batch whose labels are sampled from pods already in
+        the cluster (so vocab caps match what real pending pods of the same
+        workloads will produce), runs the device program once, and discards
+        the result — nothing is assumed, bound or queued.  With the
+        persistent XLA cache the compile is loaded, not re-run; cold, it
+        happens HERE instead of under the first scheduled pod.  Returns
+        True if a program was warmed."""
+        fwk = next(iter(self.profiles.values()))
+        self.cache.update_snapshot(self.snapshot)
+        node_infos = self.snapshot.node_info_list
+        if not node_infos:
+            return False
+        samples = [pi.pod for ni in node_infos for pi in ni.pods]
+        proto = api.Pod(
+            metadata=api.ObjectMeta(name="prewarm", namespace="default",
+                                    labels=dict(samples[0].metadata.labels)
+                                    if samples else {}),
+            spec=api.PodSpec(containers=[api.Container(
+                name="c", image="",
+                resources=api.ResourceRequirements(
+                    requests={"cpu": "1m", "memory": "1Mi"}))]))
+        # the synthetic batch carries a topology term so the warmed gang
+        # variant is intra_batch_topology=True — the serving default for
+        # real workloads (term-free batches use the cheaper static
+        # variant, whose compile is much smaller)
+        proto.spec.affinity = api.Affinity(
+            pod_anti_affinity=api.PodAntiAffinity(
+                required_during_scheduling_ignored_during_execution=[
+                    api.PodAffinityTerm(
+                        label_selector=api.LabelSelector(
+                            match_labels={"kubetpu-prewarm": "x"}),
+                        topology_key=api.LABEL_HOSTNAME)]))
+        pinfos = [PodInfo(proto)] * min(self.config.batch_size, 1024)
+        builder = SnapshotBuilder(
+            hard_pod_affinity_weight=fwk.hard_pod_affinity_weight)
+        builder.intern_pending(pinfos[:1])
+        cluster = builder.build(node_infos).to_device()
+        pb = PodBatchBuilder(builder.table)
+        batch = self._jax.tree.map(np.asarray, pb.build(pinfos))
+        cfg = programs.ProgramConfig(
+            filters=fwk.tensor_filters, scores=fwk.tensor_scores,
+            hostname_topokey=max(builder.table.topokey.get(api.LABEL_HOSTNAME), 0),
+            plugin_args=fwk.tensor_plugin_args(builder.table))
+        rng = self._jax.random.PRNGKey(0)
+        if self.config.mode == "gang":
+            if self._mesh is not None:
+                from .parallel import mesh as pmesh
+                res = pmesh.sharded_schedule_gang(cluster, batch, cfg, rng,
+                                                  self._mesh)
+            else:
+                from .models.gang import run_auction
+                res = run_auction(cluster, batch, cfg, rng)
+        elif self._mesh is not None:
+            from .parallel import mesh as pmesh
+            res = pmesh.sharded_schedule_sequential(
+                cluster, batch, cfg, rng,
+                hard_pod_affinity_weight=float(
+                    fwk.hard_pod_affinity_weight))
+        else:
+            res = schedule_sequential(
+                cluster, batch, cfg, rng,
+                hard_pod_affinity_weight=float(
+                    fwk.hard_pod_affinity_weight))
+        np.asarray(res.packed)   # wait out the compile
+        return True
+
     def run(self) -> threading.Thread:
         """Start the serving loop (reference: scheduler.go:339 Run)."""
         self.queue.run()
         self.cache.run()
+        import os
+        if (getattr(self.config, "prewarm", True)
+                and os.environ.get("KUBETPU_PREWARM", "1") != "0"):
+            try:
+                self.prewarm()
+            except Exception:
+                import logging
+                logging.getLogger("kubetpu").warning(
+                    "prewarm failed; first cycle pays the compile",
+                    exc_info=True)
 
         def loop():
             while not self._stop.is_set():

@@ -9,13 +9,40 @@ predicates done", "Prioritizing done", logged when the cycle exceeds
 
 from __future__ import annotations
 
+import contextlib
 import logging
+import os
 import time
 from typing import List, Optional, Tuple
 
 LOG = logging.getLogger("kubetpu.trace")
 
 SLOW_CYCLE_THRESHOLD = 0.1  # 100 ms (generic_scheduler.go:148 LogIfLong)
+
+# SURVEY §5: keep jax.profiler traces alongside the host-side step spans.
+# When a capture is active (capture_device_trace below, or
+# KUBETPU_PROFILE_DIR at import), every Trace phase also opens a
+# jax.profiler.TraceAnnotation so device ops group under the cycle phase
+# names in the TensorBoard/XProf timeline.
+_PROFILE_ACTIVE = False
+
+
+@contextlib.contextmanager
+def capture_device_trace(log_dir: str):
+    """Capture a jax.profiler trace (XPlane/TensorBoard format) for the
+    enclosed serving activity — the TPU analog of the reference's pprof
+    endpoints (DebuggingConfiguration.EnableProfiling, SURVEY §5).  Host
+    Trace phases appear as TraceAnnotations inside the capture."""
+    global _PROFILE_ACTIVE
+    import jax
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+    _PROFILE_ACTIVE = True
+    try:
+        yield log_dir
+    finally:
+        _PROFILE_ACTIVE = False
+        jax.profiler.stop_trace()
 
 
 class Trace:
@@ -24,14 +51,38 @@ class Trace:
         self.fields = fields
         self.start = time.time()
         self.steps: List[Tuple[float, str]] = []
+        self._ann = None
+        self._closed = False
+        if _PROFILE_ACTIVE:
+            self._open_annotation("begin")
+
+    def _close_annotation(self) -> None:
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+
+    def _open_annotation(self, label: str) -> None:
+        import jax
+        self._close_annotation()
+        if _PROFILE_ACTIVE:
+            self._ann = jax.profiler.TraceAnnotation(f"{self.name}:{label}")
+            self._ann.__enter__()
 
     def step(self, msg: str) -> None:
         self.steps.append((time.time(), msg))
+        if self._ann is not None or _PROFILE_ACTIVE:
+            self._open_annotation(msg)
+
+    def __del__(self):
+        # last-resort close so an early-return cycle can never leak an
+        # entered TraceAnnotation into the rest of the capture
+        self._close_annotation()
 
     def total(self) -> float:
         return time.time() - self.start
 
     def log_if_long(self, threshold: float = SLOW_CYCLE_THRESHOLD) -> Optional[str]:
+        self._close_annotation()
         total = self.total()
         if total < threshold:
             return None

@@ -30,7 +30,11 @@ def run_cluster(nodes: List[api.Node],
                 scores=programs.DEFAULT_SCORE_PLUGINS,
                 spread_selectors=None,
                 plugin_args=(),
+                plugin_args_fn=None,
                 seed: int = 0) -> Result:
+    """plugin_args_fn: optional callable(table) -> plugin_args tuple, for
+    args that need vocab-resolved ids (e.g. RequestedToCapacityRatio's
+    scalar-resource channel indices)."""
     existing = existing or {}
     infos = []
     for n in nodes:
@@ -47,6 +51,8 @@ def run_cluster(nodes: List[api.Node],
     pb = PodBatchBuilder(sb.table)
     batch = jax.tree.map(np.asarray,
                          pb.build(pinfos, spread_selectors=spread_selectors))
+    if plugin_args_fn is not None:
+        plugin_args = plugin_args_fn(sb.table)
     cfg = programs.ProgramConfig(
         filters=tuple(filters), scores=tuple(scores),
         hostname_topokey=sb.table.topokey.get(api.LABEL_HOSTNAME),

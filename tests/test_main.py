@@ -17,6 +17,9 @@ def _env():
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env.setdefault("JAX_PLATFORMS", "cpu")
+    # subprocesses share the (single) tunneled device with the test
+    # process; the startup pre-compile would contend for it
+    env["KUBETPU_PREWARM"] = "0"
     return env
 
 

@@ -1,0 +1,182 @@
+"""Nominated-pods topology overlay (VERDICT r3 missing #6; reference:
+addNominatedPods, core/generic_scheduler.go:530 + the two-pass filtering at
+:594-612): pods nominated by preemption contribute anti-affinity terms,
+labels and spread counts against lower/equal-priority pods — not just
+resource capacity."""
+import numpy as np
+
+from kubetpu.api import types as api
+from kubetpu.apis.config import (KubeSchedulerConfiguration,
+                                 KubeSchedulerProfile)
+from kubetpu.client.store import ClusterStore
+from kubetpu.harness import hollow
+from kubetpu.scheduler import Scheduler
+
+
+def make_sched(store, mode="gang"):
+    cfg = KubeSchedulerConfiguration(profiles=[KubeSchedulerProfile()],
+                                     batch_size=8, mode=mode)
+    return Scheduler(store, config=cfg, async_binding=False)
+
+
+def nominate(sched, pod, node_name):
+    """Park a pod in the nominator without making it poppable — the state a
+    preempting pod is in while its victims terminate (reference:
+    scheduling_queue.go nominator; the pod sits in unschedulableQ)."""
+    pod.status.nominated_node_name = node_name
+    sched.queue.add_nominated_pod(pod, node_name)
+
+
+def two_nodes(store):
+    nodes = hollow.make_nodes(2)
+    for n in nodes:
+        store.add(n)
+    return nodes
+
+
+def test_lower_priority_pod_repelled_by_nominated_anti_affinity():
+    """The VERDICT's golden: a nominated pod's required anti-affinity
+    repels a lower-priority pod from the nominated node."""
+    store = ClusterStore()
+    two_nodes(store)
+    sched = make_sched(store)
+    nom = hollow.make_pod("nom", labels={"app": "x"})
+    nom.spec.priority = 1000
+    # anti-affinity term: repel app=y within the hostname topology
+    nom.spec.affinity = api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
+        required_during_scheduling_ignored_during_execution=[
+            api.PodAffinityTerm(
+                label_selector=api.LabelSelector(match_labels={"app": "y"}),
+                topology_key=api.LABEL_HOSTNAME)]))
+    nominate(sched, nom, "node-0")
+
+    victim = hollow.make_pod("low", labels={"app": "y"})
+    victim.spec.priority = 0
+    store.add(victim)
+    out = sched.schedule_pending(timeout=0.2)
+    assert len(out) == 1
+    assert out[0].node == "node-1", (out[0].node, out[0].err)
+    sched.close()
+
+
+def test_lower_priority_pod_repelled_by_own_anti_affinity_vs_nominated():
+    """Reverse direction: the incoming pod's anti-affinity sees the
+    nominated pod's LABELS as if it were running on its nominated node."""
+    store = ClusterStore()
+    two_nodes(store)
+    sched = make_sched(store)
+    nom = hollow.make_pod("nom", labels={"app": "x"})
+    nom.spec.priority = 1000
+    nominate(sched, nom, "node-0")
+
+    pod = hollow.make_pod("low", labels={"team": "z"})
+    pod.spec.priority = 0
+    pod.spec.affinity = api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
+        required_during_scheduling_ignored_during_execution=[
+            api.PodAffinityTerm(
+                label_selector=api.LabelSelector(match_labels={"app": "x"}),
+                topology_key=api.LABEL_HOSTNAME)]))
+    store.add(pod)
+    out = sched.schedule_pending(timeout=0.2)
+    assert len(out) == 1
+    assert out[0].node == "node-1", (out[0].node, out[0].err)
+    sched.close()
+
+
+def test_higher_priority_pod_ignores_nominated():
+    """addNominatedPods only applies equal-or-greater priority nominated
+    pods (generic_scheduler.go:536): a HIGHER-priority incoming pod does
+    not see the nominated pod's terms."""
+    store = ClusterStore()
+    nodes = hollow.make_nodes(1)
+    for n in nodes:
+        store.add(n)
+    sched = make_sched(store)
+    nom = hollow.make_pod("nom", labels={"app": "x"})
+    nom.spec.priority = 10
+    nom.spec.affinity = api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
+        required_during_scheduling_ignored_during_execution=[
+            api.PodAffinityTerm(
+                label_selector=api.LabelSelector(match_labels={"app": "y"}),
+                topology_key=api.LABEL_HOSTNAME)]))
+    nominate(sched, nom, "node-0")
+
+    boss = hollow.make_pod("boss", labels={"app": "y"})
+    boss.spec.priority = 1000
+    store.add(boss)
+    out = sched.schedule_pending(timeout=0.2)
+    assert len(out) == 1
+    assert out[0].node == "node-0", (out[0].node, out[0].err)
+    sched.close()
+
+
+def test_nominated_pod_skews_topology_spread():
+    """A nominated pod's labels count into PodTopologySpread skew for
+    lower-priority pods (the AddPod extension updates the spread
+    preFilter state, podtopologyspread/plugin.go AddPod)."""
+    store = ClusterStore()
+    two_nodes(store)
+    sched = make_sched(store)
+    nom = hollow.make_pod("nom", labels={"grp": "g"})
+    nom.spec.priority = 1000
+    nominate(sched, nom, "node-0")
+
+    pod = hollow.make_pod("low", labels={"grp": "g"})
+    pod.spec.priority = 0
+    hollow.with_spread(pod, api.LABEL_HOSTNAME, max_skew=1,
+                       when="DoNotSchedule")
+    store.add(pod)
+    out = sched.schedule_pending(timeout=0.2)
+    assert len(out) == 1
+    # skew: node-0 already holds the nominated grp=g pod (1 vs 0); both
+    # nodes still satisfy maxSkew=1, but node-1 is preferred only via
+    # score — the FILTER must simply not be violated anywhere.  Make the
+    # filter bind: a second nominated pod on node-0 pushes skew to 2
+    assert out[0].node, out[0].err
+    sched.close()
+
+
+def test_two_nominated_pods_force_spread_filter():
+    """Two nominated pods on one node push hostname skew past maxSkew=1 —
+    the spread FILTER (not just score) must exclude that node."""
+    store = ClusterStore()
+    two_nodes(store)
+    sched = make_sched(store)
+    for i in range(2):
+        nom = hollow.make_pod(f"nom{i}", labels={"grp": "g"})
+        nom.spec.priority = 1000
+        nominate(sched, nom, "node-0")
+
+    pod = hollow.make_pod("low", labels={"grp": "g"})
+    pod.spec.priority = 0
+    hollow.with_spread(pod, api.LABEL_HOSTNAME, max_skew=1,
+                       when="DoNotSchedule")
+    store.add(pod)
+    out = sched.schedule_pending(timeout=0.2)
+    assert len(out) == 1
+    assert out[0].node == "node-1", (out[0].node, out[0].err)
+    sched.close()
+
+
+def test_sequential_mode_also_overlays():
+    """The overlay rides host_ok, so the sequential replay path gets the
+    same nominated-topology semantics."""
+    store = ClusterStore()
+    two_nodes(store)
+    sched = make_sched(store, mode="sequential")
+    nom = hollow.make_pod("nom", labels={"app": "x"})
+    nom.spec.priority = 1000
+    nom.spec.affinity = api.Affinity(pod_anti_affinity=api.PodAntiAffinity(
+        required_during_scheduling_ignored_during_execution=[
+            api.PodAffinityTerm(
+                label_selector=api.LabelSelector(match_labels={"app": "y"}),
+                topology_key=api.LABEL_HOSTNAME)]))
+    nominate(sched, nom, "node-0")
+
+    victim = hollow.make_pod("low", labels={"app": "y"})
+    victim.spec.priority = 0
+    store.add(victim)
+    out = sched.schedule_pending(timeout=0.2)
+    assert len(out) == 1
+    assert out[0].node == "node-1", (out[0].node, out[0].err)
+    sched.close()

@@ -1,0 +1,185 @@
+"""Permit / WaitingPod integration: co-scheduling via Permit, timeout
+rejection, and delete-rejects-waiting-pod (VERDICT r3 missing #5; reference:
+test/integration/scheduler/framework_test.go:1442
+TestCoSchedulingWithPermitPlugin and the Permit cases at :509-1632)."""
+import time
+
+from kubetpu.api import types as api
+from kubetpu.apis.config import (KubeSchedulerConfiguration,
+                                 KubeSchedulerProfile, Plugin, Plugins,
+                                 PluginSet)
+from kubetpu.client.store import ClusterStore
+from kubetpu.framework import interface as fw
+from kubetpu.framework.interface import Code, Status
+from kubetpu.harness import hollow
+from kubetpu.plugins.intree import new_in_tree_registry
+from kubetpu.scheduler import Scheduler
+
+NAME = "TestPermit"
+
+
+class CoSchedPermitPlugin(fw.PermitPlugin):
+    """reference: framework_test.go PermitPlugin — the first pod to enter
+    Permit waits; the second one allows or rejects the waiter."""
+
+    def __init__(self, handle, allow: bool, timeout: float = 10.0):
+        self.handle = handle
+        self.allow_mode = allow
+        self.timeout = timeout
+        self.waiting_pod = ""
+        self.acting_pod = ""
+        self.num_calls = 0
+
+    def name(self):
+        return NAME
+
+    def permit(self, state, pod, node_name):
+        self.num_calls += 1
+        waiting = []
+        self.handle.iterate_over_waiting_pods(waiting.append)
+        if not waiting:
+            self.waiting_pod = pod.metadata.name
+            return Status(Code.WAIT), self.timeout
+        self.acting_pod = pod.metadata.name
+        for wp in waiting:
+            if self.allow_mode:
+                wp.allow(NAME)
+            else:
+                wp.reject("rejected by peer")
+        if self.allow_mode:
+            return Status.success(), 0.0
+        return Status.unschedulable("peer rejected"), 0.0
+
+
+def permit_scheduler(store, plugin_factory, batch_size=1, mode="sequential"):
+    registry = dict(new_in_tree_registry())
+    instances = []
+
+    def factory(args, handle):
+        p = plugin_factory(handle)
+        instances.append(p)
+        return p
+
+    registry[NAME] = factory
+    cfg = KubeSchedulerConfiguration(
+        profiles=[KubeSchedulerProfile(plugins=Plugins(
+            permit=PluginSet(enabled=[Plugin(NAME)])))],
+        batch_size=batch_size, mode=mode)
+    sched = Scheduler(store, config=cfg, registry=registry,
+                      async_binding=True)
+    return sched, instances
+
+
+def two_node_store():
+    store = ClusterStore()
+    for n in hollow.make_nodes(2):
+        store.add(n)
+    return store
+
+
+def bound_names(store):
+    return {p.metadata.name for p in store.list("Pod") if p.spec.node_name}
+
+
+def test_co_scheduling_wait_then_allow():
+    """framework_test.go:1463 waitAllow row: pod A waits on permit, pod B
+    allows it — BOTH bind."""
+    store = two_node_store()
+    sched, plugins = permit_scheduler(
+        store, lambda h: CoSchedPermitPlugin(h, allow=True))
+    store.add(hollow.make_pod("pod-a"))
+    store.add(hollow.make_pod("pod-b"))
+    out1 = sched.schedule_pending(timeout=0.5)
+    assert len(out1) == 1 and out1[0].node   # A assumed, bind waiting
+    out2 = sched.schedule_pending(timeout=0.5)
+    assert len(out2) == 1 and out2[0].node
+    sched.wait_for_inflight_binds()
+    assert bound_names(store) == {"pod-a", "pod-b"}
+    p = plugins[0]
+    assert p.num_calls == 2
+    assert {p.waiting_pod, p.acting_pod} == {"pod-a", "pod-b"}
+    sched.close()
+
+
+def test_co_scheduling_wait_then_reject():
+    """framework_test.go:1459 waitReject row: pod B rejects waiting pod A
+    and fails itself — NEITHER binds, both requeue as unschedulable."""
+    store = two_node_store()
+    sched, plugins = permit_scheduler(
+        store, lambda h: CoSchedPermitPlugin(h, allow=False))
+    store.add(hollow.make_pod("pod-a"))
+    store.add(hollow.make_pod("pod-b"))
+    sched.schedule_pending(timeout=0.5)
+    out2 = sched.schedule_pending(timeout=0.5)
+    assert len(out2) == 1 and not out2[0].node   # B rejected at Permit
+    sched.wait_for_inflight_binds()
+    assert bound_names(store) == set()
+    # A's rejection rolled the assume back (ForgetPod)
+    assert not sched.cache.assumed_pods
+    # both pods report PodScheduled=False
+    for name in ("pod-a", "pod-b"):
+        pod = store.get_pod("default", name)
+        conds = {c.type: c for c in pod.status.conditions}
+        assert conds[api.POD_SCHEDULED].status == "False"
+    sched.close()
+
+
+def test_permit_timeout_rejects():
+    """framework.go:775 WaitOnPermit + waiting_pods_map timeouts: an
+    unanswered Wait rejects at its deadline and the pod is forgotten."""
+    store = two_node_store()
+    sched, plugins = permit_scheduler(
+        store, lambda h: CoSchedPermitPlugin(h, allow=True, timeout=0.3))
+    store.add(hollow.make_pod("pod-a"))
+    out = sched.schedule_pending(timeout=0.5)
+    assert len(out) == 1 and out[0].node
+    sched.wait_for_inflight_binds(timeout=5.0)
+    assert bound_names(store) == set()
+    assert not sched.cache.assumed_pods
+    pod = store.get_pod("default", "pod-a")
+    conds = {c.type: c for c in pod.status.conditions}
+    assert conds[api.POD_SCHEDULED].status == "False"
+    assert "timeout" in conds[api.POD_SCHEDULED].message
+    sched.close()
+
+
+def test_delete_rejects_waiting_pod():
+    """eventhandlers: deleting a pending pod rejects its WaitingPod
+    (scheduler.py on_pod delete -> fwk.reject_waiting_pod; reference:
+    eventhandlers.go deletePodFromSchedulingQueue + fwk.RejectWaitingPod)."""
+    store = two_node_store()
+    sched, plugins = permit_scheduler(
+        store, lambda h: CoSchedPermitPlugin(h, allow=True, timeout=30.0))
+    pod = hollow.make_pod("pod-a")
+    store.add(pod)
+    out = sched.schedule_pending(timeout=0.5)
+    assert len(out) == 1 and out[0].node
+    fwk = next(iter(sched.profiles.values()))
+    deadline = time.time() + 2.0
+    while fwk.get_waiting_pod(pod.uid) is None and time.time() < deadline:
+        time.sleep(0.01)
+    assert fwk.get_waiting_pod(pod.uid) is not None
+    store.delete(pod)
+    sched.wait_for_inflight_binds(timeout=5.0)
+    assert fwk.get_waiting_pod(pod.uid) is None
+    assert bound_names(store) == set()
+    assert not sched.cache.assumed_pods
+    sched.close()
+
+
+def test_gang_batch_admitted_together():
+    """Gang mode: a whole batch flows through Permit in one cycle — the
+    first pod waits, a later pod in the SAME batch allows it, and the
+    entire gang binds atomically (the Permit/gang hook of SURVEY §2.3)."""
+    store = two_node_store()
+    sched, plugins = permit_scheduler(
+        store, lambda h: CoSchedPermitPlugin(h, allow=True),
+        batch_size=2, mode="gang")
+    store.add(hollow.make_pod("g-1"))
+    store.add(hollow.make_pod("g-2"))
+    out = sched.schedule_pending(timeout=0.5)
+    assert len(out) == 2 and all(o.node for o in out)
+    sched.wait_for_inflight_binds()
+    assert bound_names(store) == {"g-1", "g-2"}
+    assert plugins[0].num_calls == 2
+    sched.close()
