@@ -250,10 +250,12 @@ def rescore_case(n_pods=102400, n_nodes=10240, chunk=16384):
     sb.intern_pending(pinfos)
     cluster = sb.build(node_infos).to_device()
     batch = jax.tree.map(np.asarray, PodBatchBuilder(sb.table).build(pinfos))
+    from kubetpu.scheduler import Scheduler as _S
     cfg = programs.ProgramConfig(
         filters=fwk.tensor_filters, scores=fwk.tensor_scores,
         hostname_topokey=max(sb.table.topokey.get(api.LABEL_HOSTNAME), 0),
-        plugin_args=fwk.tensor_plugin_args(sb.table))
+        plugin_args=fwk.tensor_plugin_args(sb.table),
+        active_topo_keys=_S._batch_topo_keys(sb.table, pinfos))
 
     @jax.jit
     def rescore(cluster, batch, rng):

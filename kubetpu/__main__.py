@@ -54,6 +54,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--drain-timeout", type=float, default=300.0,
                    help="--once: give up draining after this many seconds")
+    p.add_argument("--api-port", type=int, default=-1,
+                   help="serve the cluster store as a REST resource API "
+                        "(list/get/create/delete, pods/binding + status "
+                        "subresources, long-poll watch) on this port; 0 "
+                        "picks a free port; -1 (default) disables")
+    p.add_argument("--api-server",
+                   help="connect to a REMOTE kubetpu API server at this "
+                        "base URL instead of using an in-process store "
+                        "(reflector-fed local cache; writes go over HTTP)")
     return p
 
 
@@ -84,7 +93,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.leader_elect:
         config.leader_election = True
 
-    store = ClusterStore()
+    if args.api_server:
+        from .client.rest import RestClusterStore
+        store = RestClusterStore(args.api_server)
+        store.wait_for_cache_sync()
+    else:
+        store = ClusterStore()
+    api_srv = None
+    if args.api_port >= 0 and not args.api_server:
+        from .client.rest import APIServer
+        api_srv = APIServer(store, port=args.api_port)
+        api_port = api_srv.start()
+        print(json.dumps({"kubetpu": "api", "port": api_port}), flush=True)
     metrics = SchedulerMetrics()
     sched = Scheduler(store, config=config, metrics=metrics, seed=args.seed)
 

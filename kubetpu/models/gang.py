@@ -394,10 +394,12 @@ def _schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
         boot_live = None
         if use_sph:
             feas = feas & K.spread_filter(cl, batch, affinity_ok,
-                                          match_ns=sph_match)
+                                          match_ns=sph_match,
+                                          active_keys=cfg.active_keys)
         if use_ipa:
             ok, aff_unres, boot_live = K.interpod_filter(
-                cl, batch, pre=ipa_pre, return_no_matches=True)
+                cl, batch, pre=ipa_pre, return_no_matches=True,
+                active_keys=cfg.active_keys)
             feas = feas & ok
         if use_fit:
             feas = feas & K.fit_filter(cl, batch)
@@ -443,7 +445,9 @@ def _schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
         is_prop = prop < N
         defer = jnp.zeros((B,), bool)
         TK = cluster.topo_pair.shape[1]
-        for k in range(TK):
+        deferral_keys = (range(TK) if not cfg.active_topo_keys else
+                         [k for k in cfg.active_topo_keys if 0 <= k < TK])
+        for k in deferral_keys:
             pair_k = jnp.where(is_prop, cluster.topo_pair[prop_safe, k], -1)
             pair_ok = pair_k >= 0
             skey = jnp.where(pair_ok, pair_k, jnp.int32(2**30))

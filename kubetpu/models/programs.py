@@ -66,6 +66,17 @@ class ProgramConfig(NamedTuple):
     # 0 = the reference's adaptive default 50 - n/125, floor 5%; the
     # sampled search only ever binds on clusters >= 100 nodes.
     percentage_of_nodes_to_score: int = 100
+    # topology-key ids that can appear in the BATCH's term sets (affinity,
+    # anti-affinity, preferred, spread constraints).  The same-pair matmul
+    # kernels run one [S, .] x [., N] contraction per key; restricting to
+    # the keys actually present (typically 2 of the TK=8 seeded keys) cuts
+    # that work proportionally.  () = unknown -> all keys (always safe);
+    # a non-empty tuple MUST be a superset of the batch's term keys.
+    active_topo_keys: Tuple[int, ...] = ()
+
+    @property
+    def active_keys(self):
+        return self.active_topo_keys or None
 
     def arg(self, name: str, default=()):
         for n, a in self.plugin_args:
@@ -97,9 +108,11 @@ def _filter_mask(name: str, cluster, batch, cfg: ProgramConfig, affinity_ok):
     if name == "TaintToleration":
         return K.taint_filter(cluster, batch), None
     if name == "PodTopologySpread":
-        return K.spread_filter(cluster, batch, affinity_ok), None
+        return K.spread_filter(cluster, batch, affinity_ok,
+                               active_keys=cfg.active_keys), None
     if name == "InterPodAffinity":
-        ok, aff_unres = K.interpod_filter(cluster, batch)
+        ok, aff_unres = K.interpod_filter(cluster, batch,
+                                          active_keys=cfg.active_keys)
         return ok, aff_unres
     if name == "NodeLabel":
         present, absent, _ = cfg.arg("NodeLabel", ((), (), ()))
@@ -185,7 +198,8 @@ def run_scores(cluster, batch, cfg: ProgramConfig, feasible, affinity_ok,
             s = K.image_locality_score(cluster, batch)
         elif name == "InterPodAffinity":
             s = K.interpod_score(cluster, batch, feasible,
-                                 pre=pre.get("interpod_score"))
+                                 pre=pre.get("interpod_score"),
+                                 active_keys=cfg.active_keys)
         elif name == "NodeResourcesLeastAllocated":
             s = K.least_allocated_score(cluster, batch)
         elif name == "NodeResourcesMostAllocated":
@@ -198,7 +212,8 @@ def run_scores(cluster, batch, cfg: ProgramConfig, feasible, affinity_ok,
         elif name == "PodTopologySpread":
             s = K.spread_soft_score(cluster, batch, feasible, affinity_ok,
                                     cfg.hostname_topokey,
-                                    match_ns=pre.get("spread_soft"))
+                                    match_ns=pre.get("spread_soft"),
+                                    active_keys=cfg.active_keys)
         elif name == "DefaultPodTopologySpread":
             raw = K.default_spread_score(cluster, batch,
                                          match_ns=pre.get("default_spread"))
@@ -324,9 +339,11 @@ def nominated_topology_mask(cluster, nom_batch, nom_rows, nom_prio, batch,
     affinity_ok = K.node_affinity_filter(ext, batch)
     ok = jnp.ones((batch.valid.shape[0], cluster.allocatable.shape[0]), bool)
     if "PodTopologySpread" in cfg.filters:
-        ok = ok & K.spread_filter(ext, batch, affinity_ok)
+        ok = ok & K.spread_filter(ext, batch, affinity_ok,
+                                  active_keys=cfg.active_keys)
     if "InterPodAffinity" in cfg.filters:
-        ipa_ok, _ = K.interpod_filter(ext, batch)
+        ipa_ok, _ = K.interpod_filter(ext, batch,
+                                      active_keys=cfg.active_keys)
         ok = ok & ipa_ok
     affected = jnp.any(placed[None, :]
                        & (nom_prio[None, :] >= batch.priority[:, None]),

@@ -60,7 +60,8 @@ def per_node_counts(match_sp: jnp.ndarray, pod_node: jnp.ndarray, n_nodes: int) 
 
 def _samepair_pods_to_nodes(cluster, values_sp: jnp.ndarray,
                             keys_s: jnp.ndarray, pod_node: jnp.ndarray,
-                            pod_valid: jnp.ndarray) -> jnp.ndarray:
+                            pod_valid: jnp.ndarray,
+                            active_keys=None) -> jnp.ndarray:
     """out[s, n] = sum of values[s, p] over existing pods p placed on a node
     sharing node n's (keys_s[s], value) topology pair.
 
@@ -70,14 +71,22 @@ def _samepair_pods_to_nodes(cluster, values_sp: jnp.ndarray,
     elementwise.  Rows whose key id is out of [0, TK) yield zeros; nodes
     without the key receive 0; pods on nodes without the key contribute
     nothing.  values must be bf16-exact per element (bools or small ints —
-    accumulation is f32 on the MXU, so sums are exact)."""
+    accumulation is f32 on the MXU, so sums are exact).
+
+    active_keys: optional static iterable of the topology-key ids that can
+    appear in keys_s — the matmul runs ONLY for those keys (typical
+    workloads touch 2 of the TK=8 seeded keys, a 4x FLOP cut).  MUST be a
+    superset of every key in keys_s or those rows silently read 0; None
+    means all keys."""
     tp = cluster.topo_pair                      # [N, TK]
     TK = tp.shape[1]
     pod_tp = jnp.take(tp, jnp.clip(pod_node, 0, None), axis=0)  # [P, TK]
     placed = (pod_node >= 0) & pod_valid
     vals = values_sp.astype(jnp.bfloat16)
     out = jnp.zeros((values_sp.shape[0], tp.shape[0]), jnp.float32)
-    for k in range(TK):
+    keys = range(TK) if active_keys is None else \
+        [k for k in active_keys if 0 <= k < TK]
+    for k in keys:
         pk = jnp.where(placed, pod_tp[:, k], -1)            # [P]
         sp = (pk[:, None] == tp[None, :, k]) & (pk >= 0)[:, None]
         red = jnp.einsum("sp,pn->sn", vals, sp.astype(jnp.bfloat16),
@@ -87,15 +96,18 @@ def _samepair_pods_to_nodes(cluster, values_sp: jnp.ndarray,
 
 
 def _samepair_nodes(cluster, values_sn: jnp.ndarray,
-                    keys_s: jnp.ndarray) -> jnp.ndarray:
+                    keys_s: jnp.ndarray, active_keys=None) -> jnp.ndarray:
     """out[s, n] = sum of values[s, n'] over nodes n' sharing node n's
     (keys_s[s], value) pair — the node-valued sibling of
-    _samepair_pods_to_nodes ([S, N] x [N, N] matmul per key)."""
+    _samepair_pods_to_nodes ([S, N] x [N, N] matmul per key; same
+    active_keys contract)."""
     tp = cluster.topo_pair
     TK = tp.shape[1]
     vals = values_sn.astype(jnp.bfloat16)
     out = jnp.zeros(values_sn.shape, jnp.float32)
-    for k in range(TK):
+    keys = range(TK) if active_keys is None else \
+        [k for k in active_keys if 0 <= k < TK]
+    for k in keys:
         col = tp[:, k]
         sp = (col[:, None] == col[None, :]) & (col >= 0)[:, None]
         red = jnp.einsum("sn,nm->sm", vals, sp.astype(jnp.bfloat16),
@@ -290,7 +302,8 @@ def _spread_state(cluster, batch, constraints, affinity_ok, count_mask_nodes,
                        any_eligible=any_eligible)
 
 
-def spread_filter(cluster, batch, affinity_ok, match_ns=None) -> jnp.ndarray:
+def spread_filter(cluster, batch, affinity_ok, match_ns=None,
+                  active_keys=None) -> jnp.ndarray:
     """PodTopologySpread hard constraints
     (reference: podtopologyspread/filtering.go:200-283 calPreFilterState/Filter).
 
@@ -308,7 +321,8 @@ def spread_filter(cluster, batch, affinity_ok, match_ns=None) -> jnp.ndarray:
     keys = jnp.where(cons.topo_known, cons.topo_key, -1).reshape(-1)
     # matching-pod count of each node's pair, per constraint  [B*C, N]
     cnt = _samepair_pods_to_nodes(cluster, m, keys, cluster.pod_node,
-                                  cluster.pod_valid)
+                                  cluster.pod_valid,
+                                  active_keys=active_keys)
     node_pair = node_topo_pairs(cluster, cons.topo_key.reshape(-1))
     has_key = ((node_pair >= 0).reshape(B, C, N)
                & cons.topo_known.reshape(B, C)[:, :, None])
@@ -317,7 +331,8 @@ def spread_filter(cluster, batch, affinity_ok, match_ns=None) -> jnp.ndarray:
     any_eligible = jnp.any(eligible, axis=1)
     # a pair is registered iff some eligible node carries it
     elig_bc = jnp.broadcast_to(eligible[:, None, :], (B, C, N)).reshape(B * C, N)
-    registered = _samepair_nodes(cluster, elig_bc, keys) > 0.5  # [B*C, N]
+    registered = _samepair_nodes(cluster, elig_bc, keys,
+                                 active_keys=active_keys) > 0.5  # [B*C, N]
     big = jnp.float32(2**31)
     min_match = jnp.min(jnp.where(registered, cnt, big),
                         axis=1).reshape(B, C)
@@ -333,7 +348,8 @@ def spread_filter(cluster, batch, affinity_ok, match_ns=None) -> jnp.ndarray:
 
 
 def spread_soft_score(cluster, batch, feasible, affinity_ok,
-                      hostname_topokey: int, match_ns=None) -> jnp.ndarray:
+                      hostname_topokey: int, match_ns=None,
+                      active_keys=None) -> jnp.ndarray:
     """PodTopologySpread soft constraints scoring, already normalized
     (reference: podtopologyspread/scoring.go PreScore/Score/NormalizeScore)."""
     cons = batch.spread_soft
@@ -361,7 +377,8 @@ def spread_soft_score(cluster, batch, feasible, affinity_ok,
     cm_pods = cm_pods & (cluster.pod_node >= 0)[None, :]     # [B, P]
     m_counted = (m & cm_pods[:, None, :]).reshape(B * C, -1)
     cnt_pair = _samepair_pods_to_nodes(cluster, m_counted, keys,
-                                       cluster.pod_node, cluster.pod_valid)
+                                       cluster.pod_node, cluster.pod_valid,
+                                       active_keys=active_keys)
 
     # eligibility / registration from *filtered* nodes only
     all_keys = jnp.all(has_key | ~valid[:, :, None], axis=1)  # [B, N]
@@ -369,7 +386,8 @@ def spread_soft_score(cluster, batch, feasible, affinity_ok,
     scored = feasible & all_keys
     eligible = feasible & cluster.node_valid[None, :] & all_keys
     elig_bc = jnp.broadcast_to(eligible[:, None, :], (B, C, N)).reshape(B * C, N)
-    members = _samepair_nodes(cluster, elig_bc, keys)       # [B*C, N]
+    members = _samepair_nodes(cluster, elig_bc, keys,
+                              active_keys=active_keys)      # [B*C, N]
     registered = members > 0.5
 
     # distinct registered-pair count: each pair contributes
@@ -458,7 +476,8 @@ def interpod_filter_pre(cluster, batch) -> InterpodPre:
 
 def interpod_filter(cluster, batch,
                     pre: InterpodPre | None = None,
-                    return_no_matches: bool = False):
+                    return_no_matches: bool = False,
+                    active_keys=None):
     """InterPodAffinity filter.  Returns (ok, affinity_unresolvable) where
     affinity_unresolvable marks required-affinity failures
     (UnschedulableAndUnresolvable, reference: filtering.go:371-396).
@@ -479,7 +498,8 @@ def interpod_filter(cluster, batch,
     keys_r = jnp.where(ra.topo_known, ra.topo_key, -1).reshape(-1)
     contrib = jnp.broadcast_to(match_all[:, None, :], m.shape).reshape(B * Tr, -1)
     cnt = _samepair_pods_to_nodes(cluster, contrib, keys_r,
-                                  cluster.pod_node, cluster.pod_valid)
+                                  cluster.pod_node, cluster.pod_valid,
+                                  active_keys=active_keys)
     node_pair = node_topo_pairs(cluster, ra.topo_key.reshape(-1))  # [B*T, N]
     node_has_key = (node_pair >= 0).reshape(B, Tr, N) & ra.topo_known[:, :, None]
     cnt = cnt.reshape(B, Tr, N)
@@ -510,7 +530,8 @@ def interpod_filter(cluster, batch,
     ma = _pod_term_matches(cluster, raa, B, pre=pre.m_raa).reshape(B * Ta, -1)
     keys_a = jnp.where(raa.topo_known, raa.topo_key, -1).reshape(-1)
     cnt_a = _samepair_pods_to_nodes(cluster, ma, keys_a,
-                                    cluster.pod_node, cluster.pod_valid)
+                                    cluster.pod_node, cluster.pod_valid,
+                                    active_keys=active_keys)
     np_a = node_topo_pairs(cluster, raa.topo_key.reshape(-1))
     has_key_a = (np_a >= 0).reshape(B, Ta, N) & raa.topo_known[:, :, None]
     cnt_a = cnt_a.reshape(B, Ta, N)
@@ -553,7 +574,8 @@ def interpod_score_pre(cluster, batch) -> InterpodScorePre:
 
 
 def interpod_score(cluster, batch, feasible,
-                   pre: InterpodScorePre | None = None) -> jnp.ndarray:
+                   pre: InterpodScorePre | None = None,
+                   active_keys=None) -> jnp.ndarray:
     """InterPodAffinity scoring, already normalized (reference: scoring.go).
 
     Node-space formulation: the (topologyKey, value) -> weight map becomes
@@ -571,7 +593,8 @@ def interpod_score(cluster, batch, feasible,
     data = (_f(m) * pt.weight[:, :, None] * _f(pt.valid)[:, :, None])
     keys_p = jnp.where(pt.topo_known, pt.topo_key, -1).reshape(-1)
     raw1 = _samepair_pods_to_nodes(cluster, data.reshape(B * T, -1), keys_p,
-                                   cluster.pod_node, cluster.pod_valid)
+                                   cluster.pod_node, cluster.pod_valid,
+                                   active_keys=active_keys)
     raw1 = jnp.sum(raw1.reshape(B, T, N), axis=1)  # [B, N]
 
     # existing pods' terms vs incoming pod: each term pins its owner-node's

@@ -455,7 +455,10 @@ class Scheduler:
             # the sequential replay consumes it — gang needs the global view
             percentage_of_nodes_to_score=(
                 self.config.percentage_of_nodes_to_score
-                if self.config.percentage_of_nodes_to_score > 0 else 0))
+                if self.config.percentage_of_nodes_to_score > 0 else 0),
+            # restrict the same-pair matmuls to the keys THIS batch's terms
+            # actually use (superset contract, see ProgramConfig)
+            active_topo_keys=self._batch_topo_keys(builder.table, pinfos))
         from .preemption import CycleContext
         cycle_ctx = CycleContext(
             builder=builder, cluster=cluster, cfg=cfg,
@@ -694,6 +697,28 @@ class Scheduler:
             outcomes.append(outcome)
         return outcomes
 
+    @staticmethod
+    def _batch_topo_keys(table, pinfos) -> Tuple[int, ...]:
+        """Topology-key vocab ids used by the batch's term sets — the
+        static key set the same-pair matmul kernels iterate (a superset of
+        every key in the batch per the ProgramConfig contract; cluster-side
+        term paths use per-term pair gathers and need no key loop)."""
+        keys = set()
+        get = table.topokey.get
+        for pi in pinfos:
+            for term in pi.required_affinity_terms:
+                keys.add(get(term.topology_key))
+            for term in pi.required_anti_affinity_terms:
+                keys.add(get(term.topology_key))
+            for w in pi.preferred_affinity_terms:
+                keys.add(get(w.term.topology_key))
+            for w in pi.preferred_anti_affinity_terms:
+                keys.add(get(w.term.topology_key))
+            for c in pi.pod.spec.topology_spread_constraints:
+                keys.add(get(c.topology_key))
+        keys.discard(-1)
+        return tuple(sorted(keys))
+
     def _nominated_overlay_mask(self, fwk, builder, cluster, batch, live,
                                 node_infos):
         """[B, N] bool DEVICE array — False where a pod would not fit once
@@ -747,13 +772,20 @@ class Scheduler:
                 for i, (pi, row) in enumerate(topo_entries):
                     rows[i] = row
                     prio[i] = pi.pod.priority()
+                active = tuple(sorted(
+                    set(self._batch_topo_keys(
+                        builder.table, [qp_pi for qp_pi in
+                                        (PodInfo(qp.pod) for qp in live)]))
+                    | set(self._batch_topo_keys(
+                        builder.table, [pi for pi, _ in topo_entries]))))
                 topo_mask = programs.nominated_topology_mask(
                     cluster, nom_pb, jnp.asarray(rows), jnp.asarray(prio),
                     batch, programs.ProgramConfig(
                         filters=fwk.tensor_filters, scores=(),
                         hostname_topokey=max(
                             builder.table.topokey.get(api.LABEL_HOSTNAME),
-                            0)))
+                            0),
+                        active_topo_keys=active))
                 mask = mask & topo_mask
         return mask
 
@@ -1007,6 +1039,14 @@ class Scheduler:
                         label_selector=api.LabelSelector(
                             match_labels={"kubetpu-prewarm": "x"}),
                         topology_key=api.LABEL_HOSTNAME)]))
+        # a zone soft-spread makes the warmed active-key set
+        # {hostname, zone} — what typical serving batches use
+        proto.spec.topology_spread_constraints.append(
+            api.TopologySpreadConstraint(
+                max_skew=1, topology_key=api.LABEL_ZONE,
+                when_unsatisfiable="ScheduleAnyway",
+                label_selector=api.LabelSelector(
+                    match_labels={"kubetpu-prewarm": "x"})))
         pinfos = [PodInfo(proto)] * min(self.config.batch_size, 1024)
         builder = SnapshotBuilder(
             hard_pod_affinity_weight=fwk.hard_pod_affinity_weight)
@@ -1017,7 +1057,9 @@ class Scheduler:
         cfg = programs.ProgramConfig(
             filters=fwk.tensor_filters, scores=fwk.tensor_scores,
             hostname_topokey=max(builder.table.topokey.get(api.LABEL_HOSTNAME), 0),
-            plugin_args=fwk.tensor_plugin_args(builder.table))
+            plugin_args=fwk.tensor_plugin_args(builder.table),
+            active_topo_keys=self._batch_topo_keys(builder.table,
+                                                   pinfos[:1]))
         rng = self._jax.random.PRNGKey(0)
         if self.config.mode == "gang":
             if self._mesh is not None:

@@ -186,3 +186,26 @@ def test_snapshot_evicts_ghost_node_with_pods():
     c.update_snapshot(snap)
     assert snap.get("n1") is None
     assert [ni.node_name for ni in snap.node_info_list] == ["n0"]
+
+
+def test_fake_cache_hooks():
+    """reference: internal/cache/fake/fake_cache.go — injectable hooks let
+    tests observe the scheduler's assume/forget protocol without state."""
+    from kubetpu.harness import hollow
+    from kubetpu.state.fake import FakeCache
+
+    seen = {"assumed": [], "forgotten": []}
+    fake = FakeCache(
+        assume_fn=lambda p: seen["assumed"].append(p.metadata.name),
+        forget_fn=lambda p: seen["forgotten"].append(p.metadata.name),
+        is_assumed_fn=lambda p: p.metadata.name in seen["assumed"])
+    pod = hollow.make_pod("x")
+    fake.assume_pod(pod)
+    assert seen["assumed"] == ["x"]
+    assert fake.is_assumed_pod(pod)
+    fake.forget_pod(pod)
+    assert seen["forgotten"] == ["x"]
+    # everything else is a safe no-op
+    fake.add_pod(pod); fake.update_pod(pod, pod); fake.remove_pod(pod)
+    fake.finish_binding(pod)
+    assert fake.node_count() == 0 and fake.pod_count() == 0
