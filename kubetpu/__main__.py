@@ -29,8 +29,10 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-native scheduler (kube-scheduler parity build)")
     p.add_argument("--config", help="KubeSchedulerConfiguration YAML "
                    "(app/options/configfile.go:40)")
-    p.add_argument("--mode", choices=("sequential", "gang", "batch"),
-                   help="override the device execution mode")
+    p.add_argument("--mode", choices=("sequential", "gang"),
+                   help="override the device execution mode (sequential = "
+                        "bit-parity serial replay; gang = conflict-free "
+                        "auction, the throughput mode)")
     p.add_argument("--batch-size", type=int, help="override batch size")
     p.add_argument("--port", type=int, default=0,
                    help="healthz/metrics/configz port (0 = ephemeral; the "
@@ -96,7 +98,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.api_server:
         from .client.rest import RestClusterStore
         store = RestClusterStore(args.api_server)
-        store.wait_for_cache_sync()
+        if not store.wait_for_cache_sync(timeout=30.0):
+            # reference: WaitForCacheSync failure is fatal — serving
+            # against an unsynced (empty) cache schedules into the void
+            print(f"error: could not sync cache from {args.api_server}",
+                  file=sys.stderr)
+            return 1
     else:
         store = ClusterStore()
     api_srv = None
@@ -106,7 +113,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         api_port = api_srv.start()
         print(json.dumps({"kubetpu": "api", "port": api_port}), flush=True)
     metrics = SchedulerMetrics()
-    sched = Scheduler(store, config=config, metrics=metrics, seed=args.seed)
+    try:
+        sched = Scheduler(store, config=config, metrics=metrics,
+                          seed=args.seed)
+    except ConfigError as e:
+        print(f"invalid configuration: {e}", file=sys.stderr)
+        return 2
 
     if args.hollow_nodes or args.hollow_pods:
         from .harness import hollow

@@ -42,6 +42,12 @@ class APIServer:
         self._events = collections.deque(maxlen=WATCH_BUFFER)
         self._seq = 0
         self._cond = threading.Condition()
+        # ThreadingHTTPServer handles writers concurrently, but the store
+        # fans events out AFTER releasing its lock — two racing writes
+        # could reach the watch buffer in reverse order and make mirrors
+        # converge on the older state.  One server-side write mutex makes
+        # mutation + event-sequencing atomic per request.
+        self._write_lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
         for kind in codec.KINDS:
             self._subscribe(kind)
@@ -150,6 +156,10 @@ class APIServer:
         h._send(404, {"error": "not found"})
 
     def _write(self, h, method: str) -> None:
+        with self._write_lock:
+            self._write_locked(h, method)
+
+    def _write_locked(self, h, method: str) -> None:
         try:
             parts = [p for p in h.path.split("/") if p]
             body = h._body() if method != "DELETE" else {}
@@ -259,6 +269,9 @@ class RestClusterStore(ClusterStore):
         server's resourceVersions, and fan out to subscribers."""
         old = codec.decode(kind, old_doc) if old_doc else None
         new = codec.decode(kind, new_doc) if new_doc else None
+        self._apply_obj(kind, event, old, new)
+
+    def _apply_obj(self, kind: str, event: str, old, new) -> None:
         with self._lock:
             if event == "delete":
                 self._objs[kind].pop(self._key(old), None)
@@ -270,20 +283,40 @@ class RestClusterStore(ClusterStore):
 
     def _list_all(self) -> Optional[int]:
         """Initial/recovery LIST of every kind (reflector.go ListAndWatch).
-        Returns the seq to watch from — the MINIMUM of the per-kind list
-        seqs, so the window between lists is REPLAYED (applies are
-        idempotent: duplicates overwrite, deletes of absent no-op) — or
-        None if any list failed (caller retries; a partial mirror must
-        never be declared synced)."""
+        RECONCILES the mirror against the server snapshot: new objects
+        emit adds, surviving objects with newer resourceVersions emit
+        updates, and local objects absent from the server emit deletes —
+        so a relist after a watch gap repairs every divergence, including
+        deletions the gap swallowed.  Returns the seq to watch from (the
+        MINIMUM of the per-kind list seqs; the handoff window replays
+        idempotently) or None if any list failed (caller retries; a
+        partial mirror must never be declared synced)."""
         seqs = []
+        snapshots = {}
         for kind in codec.KINDS:
             try:
                 doc = self._req("GET", f"/apis/{kind}")
             except Exception:  # noqa: BLE001 — transport/server error
                 return None
             seqs.append(int(doc.get("seq", 0)))
-            for item in doc.get("items", []):
-                self._apply(kind, "add", None, item)
+            snapshots[kind] = doc.get("items", [])
+        for kind, items in snapshots.items():
+            server = {}
+            for item in items:
+                obj = codec.decode(kind, item)
+                server[self._key(obj)] = obj
+            with self._lock:
+                local = dict(self._objs[kind])
+            for key, obj in server.items():
+                old = local.get(key)
+                if old is None:
+                    self._apply_obj(kind, "add", None, obj)
+                elif (old.metadata.resource_version
+                        != obj.metadata.resource_version):
+                    self._apply_obj(kind, "update", old, obj)
+            for key, old in local.items():
+                if key not in server:
+                    self._apply_obj(kind, "delete", old, None)
         return min(seqs, default=0)
 
     def _watch_loop(self) -> None:
@@ -309,12 +342,21 @@ class RestClusterStore(ClusterStore):
             if oldest > seq + 1:
                 seq = None
                 continue
-            for ev in doc.get("events", []):
-                if ev["seq"] <= seq:
-                    continue
-                seq = ev["seq"]
-                self._apply(ev["kind"], ev["event"], ev.get("old"),
-                            ev.get("new"))
+            try:
+                for ev in doc.get("events", []):
+                    if ev["seq"] <= seq:
+                        continue
+                    seq = ev["seq"]
+                    self._apply(ev["kind"], ev["event"], ev.get("old"),
+                                ev.get("new"))
+            except Exception:  # noqa: BLE001 — decode/subscriber failure
+                # the loop must never die silently: log and RELIST, which
+                # reconciles whatever the failed event left inconsistent
+                import logging
+                logging.getLogger("kubetpu.rest").warning(
+                    "watch event application failed; relisting",
+                    exc_info=True)
+                seq = None
 
     def wait_for_cache_sync(self, timeout: float = 10.0) -> bool:
         """reference: WaitForCacheSync before the scheduler serves."""

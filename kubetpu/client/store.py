@@ -207,11 +207,15 @@ class ClusterStore:
     def bind_pvc(self, namespace: str, pvc_name: str, pv_name: str,
                  node_name: str) -> None:
         """Write the binding through the 'API' (reference:
-        scheduler_binder.go BindPodVolumes -> PVC/PV updates)."""
+        scheduler_binder.go BindPodVolumes -> PVC/PV updates).  Emits a
+        PVC update event so watchers (and REST mirrors) see the
+        binding."""
         with self._lock:
             pvc = self._objs["PersistentVolumeClaim"].get(f"{namespace}/{pvc_name}")
             if pvc is None:
                 raise NotFound(f"pvc {namespace}/{pvc_name} not found")
+            old = copy.copy(pvc)
+            old.metadata = copy.copy(pvc.metadata)
             if pv_name:
                 pvc.volume_name = pv_name
                 self._assumed_pv.pop(pv_name, None)
@@ -220,8 +224,13 @@ class ClusterStore:
                 # delayed provisioning: stamp the selected node and leave the
                 # claim Pending for the (external) provisioner (reference:
                 # volume.kubernetes.io/selected-node annotation)
+                pvc.metadata.annotations = dict(pvc.metadata.annotations)
                 pvc.metadata.annotations[
                     "volume.kubernetes.io/selected-node"] = node_name
+            pvc.metadata.resource_version += 1
+            subs_snapshot = list(self._subs["PersistentVolumeClaim"])
+        for h in subs_snapshot:
+            h("update", old, pvc)
 
     # -- spread selectors (DefaultPodTopologySpread) ------------------------
 

@@ -225,25 +225,45 @@ class VolumeZone(_VolumePlugin, fw.FilterPlugin):
     NAME = "VolumeZone"
 
     def filter(self, state: CycleState, pod: api.Pod, node_info) -> Status:
+        """reference: volume_zone.go:80 Filter — a node with NO zone labels
+        always fits (fast path); an unbound claim is skipped only under a
+        WaitForFirstConsumer class; zone/region mismatch is
+        UnschedulableAndUnresolvable (no preemption can move a node's
+        zone)."""
+        if not pod.spec.volumes:
+            return Status.success()
         node = node_info.node
+        node_constraints = {k: v for k, v in node.metadata.labels.items()
+                            if k in _ZONE_KEYS}
+        if not node_constraints:
+            return Status.success()
         for v in pod.spec.volumes:
             if not v.persistent_volume_claim:
                 continue
             pvc = self._pvc(pod, v.persistent_volume_claim)
             if pvc is None:
-                return Status.unresolvable("pvc not found")
+                return Status.error("PersistentVolumeClaim was not found: "
+                                    f"{v.persistent_volume_claim!r}")
+            if not pvc.volume_name:
+                sc = (self.store.get_storage_class(pvc.storage_class_name)
+                      if self.store and pvc.storage_class_name else None)
+                if sc is not None and \
+                        sc.volume_binding_mode == "WaitForFirstConsumer":
+                    continue   # unbound, delayed binding: skip
+                return Status.error(
+                    "PersistentVolumeClaim had no pv name and no "
+                    "WaitForFirstConsumer storageClass")
             pv = self._pv(pvc.volume_name)
             if pv is None:
-                continue  # unbound: VolumeBinding's problem
-            for key in _ZONE_KEYS:
-                want = pv.metadata.labels.get(key)
-                if want is None:
+                return Status.error("PersistentVolume was not found: "
+                                    f"{pvc.volume_name!r}")
+            for key, want in pv.metadata.labels.items():
+                if key not in _ZONE_KEYS:
                     continue
                 # PV zone labels may hold a __ separated set
                 allowed = set(want.split("__"))
-                have = node.metadata.labels.get(key)
-                if have not in allowed:
-                    return Status.unschedulable(ERR_REASON_ZONE_CONFLICT)
+                if node_constraints.get(key) not in allowed:
+                    return Status.unresolvable(ERR_REASON_ZONE_CONFLICT)
         return Status.success()
 
 

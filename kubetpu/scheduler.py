@@ -438,8 +438,10 @@ class Scheduler:
         # AND contribute topology terms (anti-affinity/spread).  The mask
         # stays a DEVICE array — pulling a [B, N] bool through the tunnel
         # would cost more than the whole device program
+        batch_topo_keys = self._batch_topo_keys(builder.table, pinfos)
         nom_mask = self._nominated_overlay_mask(fwk, builder, cluster,
-                                                batch, live, node_infos)
+                                                batch, live, node_infos,
+                                                batch_topo_keys)
         host_ok_dev = None
         if any_host:
             host_ok_dev = self._jax.numpy.asarray(host_ok)
@@ -458,7 +460,7 @@ class Scheduler:
                 if self.config.percentage_of_nodes_to_score > 0 else 0),
             # restrict the same-pair matmuls to the keys THIS batch's terms
             # actually use (superset contract, see ProgramConfig)
-            active_topo_keys=self._batch_topo_keys(builder.table, pinfos))
+            active_topo_keys=batch_topo_keys)
         from .preemption import CycleContext
         cycle_ctx = CycleContext(
             builder=builder, cluster=cluster, cfg=cfg,
@@ -720,7 +722,7 @@ class Scheduler:
         return tuple(sorted(keys))
 
     def _nominated_overlay_mask(self, fwk, builder, cluster, batch, live,
-                                node_infos):
+                                node_infos, batch_topo_keys=()):
         """[B, N] bool DEVICE array — False where a pod would not fit once
         equal-or-greater-priority NOMINATED pods are counted as running on
         their nominated nodes (reference: addNominatedPods,
@@ -773,9 +775,7 @@ class Scheduler:
                     rows[i] = row
                     prio[i] = pi.pod.priority()
                 active = tuple(sorted(
-                    set(self._batch_topo_keys(
-                        builder.table, [qp_pi for qp_pi in
-                                        (PodInfo(qp.pod) for qp in live)]))
+                    set(batch_topo_keys)
                     | set(self._batch_topo_keys(
                         builder.table, [pi for pi, _ in topo_entries]))))
                 topo_mask = programs.nominated_topology_mask(

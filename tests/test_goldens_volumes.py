@@ -1,0 +1,213 @@
+"""VolumeZone + VolumeRestrictions reference tables as goldens
+(reference: volumezone/volume_zone_test.go TestSingleZone/TestMultiZone/
+TestWithBinding; volumerestrictions/volume_restrictions_test.go)."""
+from typing import Optional
+
+from kubetpu.api import types as api
+from kubetpu.client.store import ClusterStore
+from kubetpu.framework.interface import Code, CycleState
+from kubetpu.framework.types import NodeInfo
+from kubetpu.plugins import volumes
+from tests.test_tensors import mknode
+
+ZONE_BETA = api.LABEL_ZONE_LEGACY        # failure-domain.beta.../zone
+REGION_BETA = api.LABEL_REGION_LEGACY
+ZONE = api.LABEL_ZONE                    # topology.kubernetes.io/zone
+REGION = api.LABEL_REGION
+
+
+def pvc_pod(name, claim):
+    """reference: createPodWithVolume (volume_zone_test.go:30)."""
+    return api.Pod(metadata=api.ObjectMeta(name=name, namespace="default"),
+                   spec=api.PodSpec(containers=[], volumes=[
+                       api.Volume(name="v", persistent_volume_claim=claim)]))
+
+
+def zone_store():
+    """The pv/pvc fixtures of TestSingleZone (volume_zone_test.go:49-95)."""
+    store = ClusterStore()
+    pvs = {"Vol_1": {ZONE_BETA: "us-west1-a"},
+           "Vol_2": {REGION_BETA: "us-west1", "uselessLabel": "none"},
+           "Vol_3": {REGION_BETA: "us-west1"},
+           "Vol_Stable_1": {ZONE: "us-west1-a"},
+           "Vol_Stable_2": {REGION: "us-west1", "uselessLabel": "none"},
+           # TestMultiZone's __-separated zone set (volume_zone_test.go:232)
+           "Vol_Multi": {ZONE_BETA: "us-west1-c__us-west1-a"},
+           "Vol_Multi_Stable": {ZONE: "us-west1-c__us-west1-a"}}
+    for name, labels in pvs.items():
+        store.add(api.PersistentVolume(
+            metadata=api.ObjectMeta(name=name, labels=labels)))
+    for pvc, vol in [("PVC_1", "Vol_1"), ("PVC_2", "Vol_2"),
+                     ("PVC_3", "Vol_3"), ("PVC_Stable_1", "Vol_Stable_1"),
+                     ("PVC_Stable_2", "Vol_Stable_2"),
+                     ("PVC_Multi", "Vol_Multi"),
+                     ("PVC_Multi_Stable", "Vol_Multi_Stable")]:
+        store.add(api.PersistentVolumeClaim(
+            metadata=api.ObjectMeta(name=pvc), volume_name=vol))
+    return store
+
+
+def zone_verdict(store, pod, node_labels):
+    p = volumes.VolumeZone(store=store)
+    ni = NodeInfo(mknode(name="host1", labels=dict(node_labels)))
+    return p.filter(CycleState(), pod, ni)
+
+
+class TestVolumeZoneGolden:
+    """volume_zone_test.go:95-330 (TestSingleZone + TestMultiZone rows)."""
+
+    def test_pod_without_volume(self):
+        st = zone_verdict(zone_store(), pvc_pod("p", ""),
+                          {ZONE_BETA: "us-west1-a"})
+        # a pod with no PVC volumes passes trivially
+        pod = api.Pod(metadata=api.ObjectMeta(name="p"),
+                      spec=api.PodSpec(containers=[]))
+        assert zone_verdict(zone_store(), pod,
+                            {ZONE_BETA: "us-west1-a"}).is_success()
+
+    def test_node_without_labels_fits(self):
+        # :114 — zoneless node always fits (the fast path)
+        assert zone_verdict(zone_store(), pvc_pod("p", "PVC_1"),
+                            {}).is_success()
+
+    def test_beta_zone_matched(self):
+        # :123
+        assert zone_verdict(zone_store(), pvc_pod("p", "PVC_1"),
+                            {ZONE_BETA: "us-west1-a",
+                             "uselessLabel": "none"}).is_success()
+
+    def test_beta_region_matched(self):
+        # :133
+        assert zone_verdict(zone_store(), pvc_pod("p", "PVC_2"),
+                            {REGION_BETA: "us-west1",
+                             "uselessLabel": "none"}).is_success()
+
+    def test_beta_region_mismatch_unresolvable(self):
+        # :143 — UnschedulableAndUnresolvable
+        st = zone_verdict(zone_store(), pvc_pod("p", "PVC_2"),
+                          {REGION_BETA: "no_us-west1"})
+        assert st.code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_beta_zone_mismatch_unresolvable(self):
+        # :154
+        st = zone_verdict(zone_store(), pvc_pod("p", "PVC_1"),
+                          {ZONE_BETA: "no_us-west1-a"})
+        assert st.code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_stable_zone_matched(self):
+        # :165
+        assert zone_verdict(zone_store(), pvc_pod("p", "PVC_Stable_1"),
+                            {ZONE: "us-west1-a"}).is_success()
+
+    def test_stable_region_mismatch(self):
+        # :185
+        st = zone_verdict(zone_store(), pvc_pod("p", "PVC_Stable_2"),
+                          {REGION: "no_us-west1"})
+        assert st.code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_multizone_set_matched(self):
+        # TestMultiZone :287 — "us-west1-c__us-west1-a" contains the zone
+        assert zone_verdict(zone_store(), pvc_pod("p", "PVC_Multi"),
+                            {ZONE_BETA: "us-west1-a"}).is_success()
+        assert zone_verdict(zone_store(), pvc_pod("p", "PVC_Multi_Stable"),
+                            {ZONE: "us-west1-a"}).is_success()
+
+    def test_multizone_set_mismatch(self):
+        # :296
+        st = zone_verdict(zone_store(), pvc_pod("p", "PVC_1"),
+                          {ZONE_BETA: "us-west1-b"})
+        assert st.code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+
+
+class TestVolumeZoneWithBindingGolden:
+    """volume_zone_test.go:346-450 (TestWithBinding: unbound claims)."""
+
+    def store(self):
+        store = ClusterStore()
+        store.add(api.PersistentVolume(
+            metadata=api.ObjectMeta(name="Vol_1",
+                                    labels={ZONE_BETA: "us-west1-a"})))
+        store.add(api.PersistentVolumeClaim(
+            metadata=api.ObjectMeta(name="PVC_1"), volume_name="Vol_1"))
+        store.add(api.PersistentVolumeClaim(
+            metadata=api.ObjectMeta(name="PVC_EmptySC")))
+        store.add(api.PersistentVolumeClaim(
+            metadata=api.ObjectMeta(name="PVC_WaitSC"),
+            storage_class_name="Class_Wait"))
+        store.add(api.PersistentVolumeClaim(
+            metadata=api.ObjectMeta(name="PVC_ImmediateSC"),
+            storage_class_name="Class_Immediate"))
+        store.add(api.StorageClass(
+            metadata=api.ObjectMeta(name="Class_Wait"),
+            volume_binding_mode="WaitForFirstConsumer"))
+        store.add(api.StorageClass(
+            metadata=api.ObjectMeta(name="Class_Immediate")))
+        return store
+
+    NODE = {ZONE_BETA: "us-west1-a", "uselessLabel": "none"}
+
+    def test_bound_matched(self):
+        # :408
+        assert zone_verdict(self.store(), pvc_pod("p", "PVC_1"),
+                            self.NODE).is_success()
+
+    def test_unbound_no_storage_class_errors(self):
+        # :413
+        st = zone_verdict(self.store(), pvc_pod("p", "PVC_EmptySC"),
+                          self.NODE)
+        assert st.code == Code.ERROR
+
+    def test_unbound_immediate_class_errors(self):
+        # :427 — only WaitForFirstConsumer unbound claims are skipped
+        st = zone_verdict(self.store(), pvc_pod("p", "PVC_ImmediateSC"),
+                          self.NODE)
+        assert st.code == Code.ERROR
+
+    def test_unbound_wait_class_skipped(self):
+        # :433
+        assert zone_verdict(self.store(), pvc_pod("p", "PVC_WaitSC"),
+                            self.NODE).is_success()
+
+
+def disk_pod(name, **source):
+    return api.Pod(metadata=api.ObjectMeta(name=name),
+                   spec=api.PodSpec(containers=[], volumes=[
+                       api.Volume(name="v", **source)]))
+
+
+def restrict_verdict(pod, existing):
+    p = volumes.VolumeRestrictions(store=ClusterStore())
+    ni = NodeInfo(mknode(name="host"))
+    for i, e in enumerate(existing):
+        e.spec.node_name = "host"
+        ni.add_pod(e)
+    return p.filter(CycleState(), pod, ni)
+
+
+class TestVolumeRestrictionsGolden:
+    """volume_restrictions_test.go:28-230 (GCE/AWS/RBD/ISCSI conflict
+    rows: nothing / one state / same state / different state)."""
+
+    def check(self, kind):
+        foo = disk_pod("foo", **{kind: "foo"})
+        foo2 = disk_pod("foo2", **{kind: "foo"})
+        bar = disk_pod("bar", **{kind: "bar"})
+        empty = api.Pod(metadata=api.ObjectMeta(name="e"),
+                        spec=api.PodSpec(containers=[]))
+        assert restrict_verdict(empty, []).is_success()
+        assert restrict_verdict(empty, [foo]).is_success()
+        st = restrict_verdict(foo2, [foo])
+        assert not st.is_success() and st.code == Code.UNSCHEDULABLE
+        assert restrict_verdict(bar, [foo]).is_success()
+
+    def test_gce_conflicts(self):
+        self.check("gce_persistent_disk")
+
+    def test_aws_conflicts(self):
+        self.check("aws_elastic_block_store")
+
+    def test_rbd_conflicts(self):
+        self.check("rbd")
+
+    def test_iscsi_conflicts(self):
+        self.check("iscsi")

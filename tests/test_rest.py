@@ -152,3 +152,25 @@ def test_watch_gap_triggers_relist(server):
     assert wait_until(lambda: client.get_node("gap-node") is not None,
                       timeout=30.0)
     client.close()
+
+
+def test_pvc_binding_propagates_over_watch(server):
+    """bind_pvc emits a PVC update event (store.py), so a REST mirror sees
+    the binding and its PV assume-cache entry clears — two clients can
+    never double-allocate a PV (review finding)."""
+    store, url = server
+    store.add(api.PersistentVolume(metadata=api.ObjectMeta(name="pv1")))
+    store.add(api.PersistentVolumeClaim(
+        metadata=api.ObjectMeta(name="c1")))
+    client = RestClusterStore(url)
+    assert client.wait_for_cache_sync()
+    client.assume_pv_binding("pv1", "c1")
+    assert client.pv_is_bound("pv1")          # assumed locally
+    client.bind_pvc("default", "c1", "pv1", "node-x")
+    assert wait_until(lambda: (client.get_pvc("default", "c1") or
+                               api.PersistentVolumeClaim()).volume_name
+                      == "pv1")
+    # bound durably (via the mirror), not just assumed
+    assert client.pv_is_bound("pv1")
+    assert store.get_pvc("default", "c1").volume_name == "pv1"
+    client.close()
