@@ -8,6 +8,9 @@
 - imagelocality/image_locality_test.go:32-330 (TestImageLocalityPriority)
 - noderesources/requested_to_capacity_ratio_test.go:32-63 + :186-320
   (TestRequestedToCapacityRatio + extended-resource bin packing)
+- serviceaffinity/service_affinity_test.go:186-379 (zone-aware scoring)
+- tainttoleration/taint_toleration_test.go:260-340 (filter table)
+- nodepreferavoidpods/node_prefer_avoid_pods_test.go:83-140
 """
 from typing import Dict, List, Optional
 
@@ -15,7 +18,8 @@ import numpy as np
 
 from kubetpu.api import types as api
 from tests.harness import run_cluster
-from tests.test_goldens import make_node, respod
+from tests.test_goldens import (make_node, respod, taint,
+                                taint_node, tol_pod, toleration)
 from tests.test_tensors import mknode
 
 MAX = 100
@@ -693,3 +697,119 @@ class TestServiceAffinityScoreGolden:
                        [(self.L1, "default")], nodes=self.ZONE_RACK)
         assert got == {"machine11": 25, "machine12": 75, "machine21": 25,
                        "machine22": 25, "machine01": 0, "machine02": 0}
+
+
+# ---------------------------------------------------------------------------
+# TaintToleration (filter) + NodePreferAvoidPods
+
+
+def taint_fits(pod_tolerations, node_taints):
+    pod = tol_pod([toleration(*t) for t in pod_tolerations])
+    nodes = [taint_node("nodeA", [taint(*t) for t in node_taints])]
+    res = run_cluster(nodes, {}, [pod], filters=("TaintToleration",),
+                      scores=())
+    return bool(res.feasible[0, 0]), bool(res.unresolvable[0, 0])
+
+
+class TestTaintTolerationFilterGolden:
+    """tainttoleration/taint_toleration_test.go:260-340
+    (TestTaintTolerationFilter) — untolerated NoSchedule taints are
+    UnschedulableAndUnresolvable."""
+    NOSCHED = "NoSchedule"
+    PREFER = "PreferNoSchedule"
+
+    def test_no_tolerations_rejected(self):
+        # :269
+        assert taint_fits([], [("dedicated", "user1", self.NOSCHED)]) == \
+            (False, True)
+
+    def test_matching_toleration_fits(self):
+        # :276
+        assert taint_fits([("dedicated", "user1", self.NOSCHED)],
+                          [("dedicated", "user1", self.NOSCHED)]) == \
+            (True, False)
+
+    def test_wrong_value_rejected(self):
+        # :281
+        assert taint_fits([("dedicated", "user2", self.NOSCHED)],
+                          [("dedicated", "user1", self.NOSCHED)]) == \
+            (False, True)
+
+    def test_exists_operator_tolerates(self):
+        # :288
+        assert taint_fits([("foo", "", self.NOSCHED, "Exists")],
+                          [("foo", "bar", self.NOSCHED)]) == (True, False)
+
+    def test_multiple_taints_all_tolerated(self):
+        # :293
+        assert taint_fits([("dedicated", "user2", self.NOSCHED),
+                           ("foo", "", self.NOSCHED, "Exists")],
+                          [("dedicated", "user2", self.NOSCHED),
+                           ("foo", "bar", self.NOSCHED)]) == (True, False)
+
+    def test_effect_mismatch_rejected(self):
+        # :304 — PreferNoSchedule toleration does not cover NoSchedule
+        assert taint_fits([("foo", "bar", self.PREFER)],
+                          [("foo", "bar", self.NOSCHED)]) == (False, True)
+
+    def test_empty_effect_matches_all(self):
+        # :312
+        assert taint_fits([("foo", "bar", "")],
+                          [("foo", "bar", self.NOSCHED)]) == (True, False)
+
+    def test_prefer_no_schedule_never_filters(self):
+        # :318 and :324 — PreferNoSchedule taints are score-only
+        assert taint_fits([("dedicated", "user2", self.NOSCHED)],
+                          [("dedicated", "user1", self.PREFER)]) == \
+            (True, False)
+        assert taint_fits([], [("dedicated", "user1", self.PREFER)]) == \
+            (True, False)
+
+
+AVOID_RC = """{"preferAvoidPods": [{"podSignature": {"podController":
+ {"apiVersion": "v1", "kind": "ReplicationController", "name": "foo",
+  "uid": "abcdef123456", "controller": true}},
+ "reason": "some reason", "message": "some message"}]}"""
+AVOID_RS = AVOID_RC.replace("ReplicationController", "ReplicaSet") \
+    .replace("abcdef123456", "qwert12345")
+
+
+def avoid_scores(owner_kind, owner_uid, controller=True):
+    n1 = mknode(name="machine1")
+    n1.metadata.annotations[api.PREFER_AVOID_PODS_ANNOTATION_KEY] = AVOID_RC
+    n2 = mknode(name="machine2")
+    n2.metadata.annotations[api.PREFER_AVOID_PODS_ANNOTATION_KEY] = AVOID_RS
+    n3 = mknode(name="machine3")
+    pod = api.Pod(metadata=api.ObjectMeta(
+        name="p", owner_references=[api.OwnerReference(
+            kind=owner_kind, name="foo", uid=owner_uid,
+            controller=controller)]),
+        spec=api.PodSpec(containers=[]))
+    res = run_cluster([n1, n2, n3], {}, [pod], filters=(),
+                      scores=(("NodePreferAvoidPods", 1),))
+    return [int(s) for s in
+            np.asarray(res.plugin_scores["NodePreferAvoidPods"])[0]]
+
+
+class TestNodePreferAvoidPodsGolden:
+    """nodepreferavoidpods/node_prefer_avoid_pods_test.go:83-140
+    (TestNodePreferAvoidPods)."""
+
+    def test_rc_owner_avoids_machine1(self):
+        # :99 -> [0, MAX, MAX]
+        assert avoid_scores("ReplicationController",
+                            "abcdef123456") == [0, MAX, MAX]
+
+    def test_rs_owner_avoids_machine2(self):
+        # 4th row -> [MAX, 0, MAX]
+        assert avoid_scores("ReplicaSet", "qwert12345") == [MAX, 0, MAX]
+
+    def test_random_controller_ignored(self):
+        # :112
+        assert avoid_scores("RandomController",
+                            "abcdef123456") == [MAX, MAX, MAX]
+
+    def test_non_controller_owner_ignored(self):
+        # :125
+        assert avoid_scores("ReplicationController", "abcdef123456",
+                            controller=False) == [MAX, MAX, MAX]
