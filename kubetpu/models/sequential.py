@@ -514,22 +514,24 @@ def _schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
         if use_ds:
             raw = carry["ds_cnt"][i]
             max_node = jnp.maximum(jnp.max(jnp.where(feas, raw, neg)), 0.0)
-            zid = jnp.where((cluster.zone_id >= 0) & cluster.node_valid,
-                            cluster.zone_id, N)
-            zcounts = jax.ops.segment_sum(jnp.where(feas, raw, 0.0), zid,
-                                          num_segments=N + 1)[:N]
-            have_zones = jnp.any(feas & (cluster.zone_id >= 0))
+            zh = cluster.zone_hot          # [N, Z], zero rows when zoneless
+            has_zone = jnp.any(zh > 0, axis=1)
+            zcounts = jnp.einsum("n,nz->z", jnp.where(feas, raw, 0.0), zh,
+                                 precision=jax.lax.Precision.HIGHEST,
+                                 preferred_element_type=jnp.float32)
+            have_zones = jnp.any(feas & has_zone)
             max_zone = jnp.maximum(jnp.max(zcounts), 0.0)
             f_score = jnp.where(max_node > 0,
                                 K.MAX_NODE_SCORE * (max_node - raw)
                                 / jnp.maximum(max_node, 1.0), K.MAX_NODE_SCORE)
-            nzc = jnp.take(jnp.append(zcounts, 0.0),
-                           jnp.clip(cluster.zone_id, 0, None))
+            nzc = jnp.einsum("z,nz->n", zcounts, zh,
+                             precision=jax.lax.Precision.HIGHEST,
+                             preferred_element_type=jnp.float32)
             z_score = jnp.where(max_zone > 0,
                                 K.MAX_NODE_SCORE * (max_zone - nzc)
                                 / jnp.maximum(max_zone, 1.0), K.MAX_NODE_SCORE)
             wz = (f_score * (1.0 - K.ZONE_WEIGHTING)) + K.ZONE_WEIGHTING * z_score
-            s = jnp.floor(jnp.where(have_zones & (cluster.zone_id >= 0), wz, f_score))
+            s = jnp.floor(jnp.where(have_zones & has_zone, wz, f_score))
             s = jnp.where(batch.spread_skip[i], 0.0, s)
             total += jnp.where(feas, s, 0.0) * score_w["DefaultPodTopologySpread"]
 

@@ -782,35 +782,40 @@ ZONE_WEIGHTING = 2.0 / 3.0  # reference: default_pod_topology_spread.go:44
 
 
 def default_spread_normalize(cluster, batch, raw, feasible) -> jnp.ndarray:
-    """Zone-aware normalization (reference: default_pod_topology_spread.go:104-166)."""
-    Z = int(cluster.zone_id.shape[0])  # upper bound on zone count: N
+    """Zone-aware normalization (reference: default_pod_topology_spread.go:104-166).
+
+    Zone aggregation rides cluster.zone_hot [N, Z] with Z = the zone-vocab
+    bucket (typically 8-16), so both the per-zone sum and the
+    gather-back-to-nodes are tiny [., Z] matmuls.  The earlier formulation
+    used an [N, N] zone one-hot: at 8k nodes its HIGHEST-precision
+    [B, N] x [N, N] contraction plus an [B, N] gather was ~800 ms/round —
+    the single largest op in the gang auction."""
     big = jnp.float32(2**62)
     raw_f = jnp.where(feasible, raw, 0.0)
     max_node = jnp.max(jnp.where(feasible, raw, -big), axis=1, keepdims=True)
     max_node = jnp.maximum(max_node, 0.0)
 
-    zid = jnp.where((cluster.zone_id >= 0) & cluster.node_valid, cluster.zone_id, Z)
-    zone_oh = (zid[:, None] == jnp.arange(Z)[None, :])  # [N, Z]
-    counts_by_zone = jnp.einsum("bn,nz->bz", raw_f, zone_oh.astype(raw_f.dtype),
+    zh = cluster.zone_hot  # [N, Z]; zero rows for zoneless/invalid nodes
+    has_zone = jnp.any(zh > 0, axis=1)  # [N]
+    counts_by_zone = jnp.einsum("bn,nz->bz", raw_f, zh,
                                 precision=jax.lax.Precision.HIGHEST,
                                 preferred_element_type=jnp.float32)  # [B, Z]
-    have_zone_node = feasible & (cluster.zone_id >= 0)[None, :]
-    have_zones = jnp.any(have_zone_node, axis=1, keepdims=True)
+    have_zones = jnp.any(feasible & has_zone[None, :], axis=1, keepdims=True)
     max_zone = jnp.maximum(jnp.max(counts_by_zone, axis=1, keepdims=True), 0.0)
 
     f_score = jnp.where(max_node > 0,
                         MAX_NODE_SCORE * (max_node - raw) / jnp.maximum(max_node, 1.0),
                         MAX_NODE_SCORE)
-    node_zone_count = jnp.take_along_axis(
-        jnp.pad(counts_by_zone, ((0, 0), (0, 1))),
-        jnp.broadcast_to(jnp.clip(cluster.zone_id, 0, None)[None, :],
-                         raw.shape), axis=1)
+    # one nonzero term per output (one-hot) => exact regardless of precision
+    node_zone_count = jnp.einsum("bz,nz->bn", counts_by_zone, zh,
+                                 precision=jax.lax.Precision.HIGHEST,
+                                 preferred_element_type=jnp.float32)
     zone_score = jnp.where(max_zone > 0,
                            MAX_NODE_SCORE * (max_zone - node_zone_count)
                            / jnp.maximum(max_zone, 1.0),
                            MAX_NODE_SCORE)
     with_zone = (f_score * (1.0 - ZONE_WEIGHTING)) + ZONE_WEIGHTING * zone_score
-    out = jnp.where(have_zones & (cluster.zone_id >= 0)[None, :], with_zone, f_score)
+    out = jnp.where(have_zones & has_zone[None, :], with_zone, f_score)
     out = jnp.floor(out)
     out = jnp.where(batch.spread_skip[:, None], 0.0, out)
     return jnp.where(feasible, out, 0.0)

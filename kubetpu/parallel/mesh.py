@@ -43,7 +43,7 @@ AXIS_NODES = "nodes"
 NODE_AXIS_FIELDS = frozenset({
     "allocatable", "requested", "nonzero_requested", "node_valid",
     "unschedulable", "kv", "keymask", "num", "topo_pair", "taints", "ports",
-    "images", "avoid_hot", "zone_id",
+    "images", "avoid_hot", "zone_hot",
 })
 # ClusterTensors fields whose leading axis is the existing-pods axis P.
 POD_AXIS_FIELDS = frozenset({

@@ -90,7 +90,13 @@ class ClusterTensors(NamedTuple):
     images: jnp.ndarray             # [N, I] bool
     avoid_hot: jnp.ndarray          # [N, AV] bool — node's preferAvoidPods entries
                                     #   over the (controller kind, uid) vocab
-    zone_id: jnp.ndarray            # [N] i32 GetZoneKey id (-1 no zone info)
+    zone_hot: jnp.ndarray           # [N, Z] f32 one-hot over the ZONE vocab
+                                    #   (Z = pow2 zone-count bucket, NOT N:
+                                    #   zone aggregation must stay a tiny
+                                    #   [., Z] matmul — an [N, N] one-hot
+                                    #   made DefaultPodTopologySpread's
+                                    #   normalize the single most expensive
+                                    #   op at 8k nodes)
     # vocab-side metadata ---------------------------------------------------
     taint_is_hard: jnp.ndarray      # [T] bool (NoSchedule | NoExecute)
     taint_is_prefer: jnp.ndarray    # [T] bool (PreferNoSchedule)
@@ -241,7 +247,7 @@ class SnapshotBuilder:
             "ports": np.zeros((N, P), bool),
             "images": np.zeros((N, I), bool),
             "avoid_hot": np.zeros((N, AV), bool),
-            "zone_id": np.full((N,), -1, np.int32),
+            "zone_hot": np.zeros((N, t.zone.cap), np.float32),
             "taint_is_hard": np.zeros((T,), bool),
             "taint_is_prefer": np.zeros((T,), bool),
             "image_size": np.zeros((I,), np.float32),
@@ -308,7 +314,7 @@ class SnapshotBuilder:
                 d["avoid_hot"][n_idx, t.avoid.get((kind, uid))] = True
             zk = zone_key(node)
             if zk:
-                d["zone_id"][n_idx] = t.zone.get(zk)
+                d["zone_hot"][n_idx, t.zone.get(zk)] = 1.0
 
             for pi in ni.pods:
                 p = pi.pod
