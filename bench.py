@@ -118,6 +118,7 @@ def run_mode(mode, n_nodes, n_pods, existing_per_node, repeats,
         sched.device_wait_s = 0.0
         outcomes = []
         cycle_times = []
+        cycle_rounds = []
         t0 = time.time()
         while True:
             tc = time.time()
@@ -125,6 +126,7 @@ def run_mode(mode, n_nodes, n_pods, existing_per_node, repeats,
             if not out:
                 break
             cycle_times.append(time.time() - tc)
+            cycle_rounds.append(sched.last_gang_rounds)
             outcomes.extend(out)
         dt = time.time() - t0
         if attempt == 0:
@@ -138,6 +140,8 @@ def run_mode(mode, n_nodes, n_pods, existing_per_node, repeats,
             "device_wait_s": round(sched.device_wait_s, 3),
             "host_share": round(1.0 - sched.device_wait_s / max(dt, 1e-9), 3),
         }
+        if mode == "gang":
+            stats["auction_rounds_max"] = max(cycle_rounds, default=0)
     if repeats == 0:
         best = first
     return best, first, outcomes, sched, stats

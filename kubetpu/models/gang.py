@@ -91,11 +91,12 @@ class GangResult(NamedTuple):
     all_unresolvable: jnp.ndarray  # [B] bool — every failed node failed
                             # UnschedulableAndUnresolvable (preemption gate,
                             # scheduler.go:391; matches SeqResult's field)
-    packed: jnp.ndarray     # [3*B] i32 = concat(chosen, n_feasible,
-                            # all_unresolvable) — the host's per-cycle view
-                            # in ONE device->host readback (the tunnel pays
-                            # ~100 ms latency PER transfer, so the serving
-                            # loop must pull exactly one small array)
+    packed: jnp.ndarray     # [3*B + 1] i32 = concat(chosen, n_feasible,
+                            # all_unresolvable, [rounds]) — the host's
+                            # per-cycle view in ONE device->host readback
+                            # (the tunnel pays ~100 ms latency PER transfer,
+                            # so the serving loop must pull exactly one
+                            # small array)
 
 
 def _segment_base(values: jnp.ndarray, is_start: jnp.ndarray) -> jnp.ndarray:
@@ -267,7 +268,8 @@ def schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
                   host_ok: Optional[jnp.ndarray] = None,
                   max_rounds: Optional[int] = None,
                   intra_batch_topology: bool = True,
-                  tie_index: Optional[jnp.ndarray] = None) -> GangResult:
+                  tie_index: Optional[jnp.ndarray] = None,
+                  residual_window: int = 512) -> GangResult:
     """Python entry for the jitted auction.  The indirection is a REQUIRED
     workaround for this runtime's jit dispatch: calling the jit object
     directly from multiple call sites with different static-arg
@@ -278,17 +280,20 @@ def schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
     return _schedule_gang(cluster, batch, cfg, rng, host_ok=host_ok,
                           max_rounds=max_rounds,
                           intra_batch_topology=intra_batch_topology,
-                          tie_index=tie_index)
+                          tie_index=tie_index,
+                          residual_window=residual_window)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("cfg", "max_rounds",
-                                    "intra_batch_topology"))
+                                    "intra_batch_topology",
+                                    "residual_window"))
 def _schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
                    host_ok: Optional[jnp.ndarray] = None,
                    max_rounds: Optional[int] = None,
                    intra_batch_topology: bool = True,
-                   tie_index: Optional[jnp.ndarray] = None) -> GangResult:
+                   tie_index: Optional[jnp.ndarray] = None,
+                   residual_window: int = 512) -> GangResult:
     from .batch import densify_for
     batch = densify_for(cluster, batch)
     B = batch.req.shape[0]
@@ -325,23 +330,29 @@ def _schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
 
     ext = _extend_cluster(cluster, batch) if intra else cluster
     score_names = set(n for n, _ in cfg.scores)
-    score_pre = None
+    # assignment-independent raw scores: computed ONCE; only their
+    # normalization (a [B, N] reduce over the evolving feasible mask)
+    # stays in the round loop.  node_affinity_score alone re-ran a full
+    # [B*Tp, L] x [N, L] selector match per round before this.
+    from .programs import static_raw_scores
+    score_pre = dict(static_raw_scores(ext, batch, cfg))
+    # hoist every assignment-independent match matrix out of the round
+    # loop: only the segment/gather work that depends on the carry's
+    # assignments runs per round.  The score pres are needed regardless of
+    # intra_batch_topology: windowed sub-rounds row-gather ONLY these
+    # matrices (the SelectorSets stay full-size), so a score kernel falling
+    # back to selector matching against a width-W batch would crash.
+    if "InterPodAffinity" in score_names:
+        score_pre["interpod_score"] = K.interpod_score_pre(ext, batch)
+    if "PodTopologySpread" in score_names:
+        score_pre["spread_soft"] = K.spread_match_ns(ext, batch,
+                                                     batch.spread_soft)
+    if "DefaultPodTopologySpread" in score_names:
+        score_pre["default_spread"] = K.default_spread_match_ns(ext, batch)
     if intra:
-        # hoist every assignment-independent match matrix out of the round
-        # loop: only the segment/gather work that depends on the carry's
-        # assignments runs per round
         sph_match = (K.spread_match_ns(ext, batch, batch.spread)
                      if use_sph else None)
         ipa_pre = K.interpod_filter_pre(ext, batch) if use_ipa else None
-        score_pre = {}
-        if "InterPodAffinity" in score_names:
-            score_pre["interpod_score"] = K.interpod_score_pre(ext, batch)
-        if "PodTopologySpread" in score_names:
-            score_pre["spread_soft"] = K.spread_match_ns(ext, batch,
-                                                         batch.spread_soft)
-        if "DefaultPodTopologySpread" in score_names:
-            score_pre["default_spread"] = K.default_spread_match_ns(ext,
-                                                                    batch)
     if use_ipa:
         has_ra = jnp.any(batch.ra.valid, axis=1)
         ra_boot = (jnp.all(batch.ra.self_match | ~batch.ra.valid, axis=1)
@@ -374,7 +385,83 @@ def _schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
         unres=static_unres,
         rounds=jnp.int32(0),
         progress=jnp.bool_(True),
+        # windowed-residual bookkeeping: pods proven infeasible in a round
+        # with no admission leave the selection pool until an admission
+        # re-opens feasibility (see _round below)
+        retired=jnp.zeros((B,), bool),
     )
+
+    # ---- width-W views of every per-pod tensor the round math reads ----
+    TERM_ROW_FIELDS = ("ns_hot", "topo_key", "topo_known", "weight",
+                       "valid", "self_match", "max_skew")
+
+    def _gather_terms(t, rsafe):
+        """Row-gather the dense [B, ...] companion arrays of a
+        PodTerms/SpreadConstraints set.  The SelectorSet stays full-size:
+        every in-round kernel consumes the precomputed match matrices
+        (sph_match / ipa_pre / score_pre), never the selectors."""
+        return t._replace(**{f: jnp.take(getattr(t, f), rsafe, axis=0)
+                             for f in TERM_ROW_FIELDS if f in t._fields})
+
+    def full_sub():
+        sb = dict(rows=jnp.arange(B, dtype=jnp.int32), valid=batch.valid,
+                  batch=batch, static_ok=static_ok, ports_ok0=ports_ok0,
+                  affinity_ok=affinity_ok, tie_keys=tie_keys,
+                  score_pre=score_pre)
+        if intra:
+            sb["sph_match"] = sph_match
+            sb["ipa_pre"] = ipa_pre
+        if use_ipa:
+            sb["ra_boot"] = ra_boot
+            sb["mu_raa"] = mu_raa
+            sb["raa_uidx"] = raa_uidx
+        if use_sph:
+            sb["mu_sph"] = mu_sph
+            sb["sph_uidx"] = sph_uidx
+        return sb
+
+    def gather_sub(rows):
+        rsafe = jnp.clip(rows, 0, B - 1)
+        wvalid = rows < B
+
+        def g(x):
+            return jnp.take(x, rsafe, axis=0)
+
+        def g_pre(v):
+            if isinstance(v, K.InterpodPre):
+                return K.InterpodPre(m_ra=g(v.m_ra), m_raa=g(v.m_raa),
+                                     em=v.em[:, rsafe])
+            if isinstance(v, K.InterpodScorePre):
+                return K.InterpodScorePre(m_pref=g(v.m_pref),
+                                          em=v.em[:, rsafe])
+            return g(v)
+
+        sub_batch = batch._replace(
+            req=g(batch.req), nonzero_req=g(batch.nonzero_req),
+            ports_hot=g(batch.ports_hot),
+            ports_asnode_hot=g(batch.ports_asnode_hot),
+            spread_skip=g(batch.spread_skip),
+            valid=g(batch.valid) & wvalid,
+            ra=_gather_terms(batch.ra, rsafe),
+            raa=_gather_terms(batch.raa, rsafe),
+            pref=_gather_terms(batch.pref, rsafe),
+            spread=_gather_terms(batch.spread, rsafe),
+            spread_soft=_gather_terms(batch.spread_soft, rsafe))
+        sb = dict(rows=rows, valid=sub_batch.valid, batch=sub_batch,
+                  static_ok=g(static_ok), ports_ok0=g(ports_ok0),
+                  affinity_ok=g(affinity_ok), tie_keys=g(tie_keys),
+                  score_pre={k: g_pre(v) for k, v in score_pre.items()})
+        if intra:
+            sb["sph_match"] = g(sph_match) if use_sph else None
+            sb["ipa_pre"] = g_pre(ipa_pre) if use_ipa else None
+        if use_ipa:
+            sb["ra_boot"] = g(ra_boot)
+            sb["mu_raa"] = mu_raa[:, rsafe]
+            sb["raa_uidx"] = g(raa_uidx)
+        if use_sph:
+            sb["mu_sph"] = mu_sph[:, rsafe]
+            sb["sph_uidx"] = g(sph_uidx)
+        return sb
 
     def cluster_at(c):
         """The cluster as this round sees it: committed resource usage, and
@@ -388,26 +475,27 @@ def _schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
             cl = cl._replace(pod_node=pod_node, pod_valid=pod_valid)
         return cl
 
-    def feasibility(c, cl):
-        feas = static_ok
+    def feasibility(c, cl, sb):
+        feas = sb["static_ok"]
+        sbatch = sb["batch"]
         aff_unres = None
         boot_live = None
         if use_sph:
-            feas = feas & K.spread_filter(cl, batch, affinity_ok,
-                                          match_ns=sph_match,
+            feas = feas & K.spread_filter(cl, sbatch, sb["affinity_ok"],
+                                          match_ns=sb["sph_match"],
                                           active_keys=cfg.active_keys)
         if use_ipa:
             ok, aff_unres, boot_live = K.interpod_filter(
-                cl, batch, pre=ipa_pre, return_no_matches=True,
+                cl, sbatch, pre=sb["ipa_pre"], return_no_matches=True,
                 active_keys=cfg.active_keys)
             feas = feas & ok
         if use_fit:
-            feas = feas & K.fit_filter(cl, batch)
+            feas = feas & K.fit_filter(cl, sbatch)
         if use_ports:
             batch_conf = jnp.einsum(
-                "bp,np->bn", batch.ports_hot, c["ports_used"],
+                "bp,np->bn", sbatch.ports_hot, c["ports_used"],
                 preferred_element_type=jnp.float32) > 0.5
-            feas = feas & ports_ok0 & ~batch_conf
+            feas = feas & sb["ports_ok0"] & ~batch_conf
         return feas, aff_unres, boot_live
 
     def _rules_for(terms, mu, uidx, k, pair_ok, order, is_start, admit_cap,
@@ -417,33 +505,35 @@ def _schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
         matches one of j's key-k term selectors.  rule B (anti only): pod j
         defers iff it matches a key-k anti term of an earlier-admitted pod
         in the same pair."""
-        key_terms = _key_terms_mask(terms, k)  # [B, T]
+        W = admit_cap.shape[0]
+        key_terms = _key_terms_mask(terms, k)  # [W, T]
         adm = _f(admit_cap & pair_ok)[:, None]
         # events A: admitted pods as selector members
-        e_a = mu.T * adm                               # [B, U]
+        e_a = mu.T * adm                               # [W, U]
         pref_a = jnp.zeros_like(e_a).at[order].set(
             _seg_prefix(e_a[order], is_start))
-        hits = jnp.take_along_axis(pref_a, uidx, axis=1) > 0  # [B, T]
+        hits = jnp.take_along_axis(pref_a, uidx, axis=1) > 0  # [W, T]
         defer = jnp.any(hits & key_terms, axis=1) & pair_ok
         if anti:
             # events B: admitted pods registering their key-k selectors
             reg = jnp.zeros_like(e_a).at[
-                jnp.arange(B)[:, None], uidx].max(_f(key_terms))
+                jnp.arange(W)[:, None], uidx].max(_f(key_terms))
             e_b = reg * adm
             pref_b = jnp.zeros_like(e_b).at[order].set(
                 _seg_prefix(e_b[order], is_start))
             defer = defer | (jnp.any((pref_b > 0) & mu.T, axis=1) & pair_ok)
         return defer
 
-    def topology_deferral(admit_cap, prop, boot_live):
+    def topology_deferral(sb, admit_cap, prop, boot_live):
         """Selector-precise intra-round serialization: see module
         docstring.  One stable sort by landing pair per topology key; the
         per-pair exclusive prefix sums run in unique-selector space
-        (O(B x U) per key), so deferral only triggers on genuinely
+        (O(W x U) per key), so deferral only triggers on genuinely
         interacting pods — not on mere pair co-occupancy."""
+        W = prop.shape[0]
         prop_safe = jnp.clip(prop, 0, N - 1)
         is_prop = prop < N
-        defer = jnp.zeros((B,), bool)
+        defer = jnp.zeros((W,), bool)
         TK = cluster.topo_pair.shape[1]
         deferral_keys = (range(TK) if not cfg.active_topo_keys else
                          [k for k in cfg.active_topo_keys if 0 <= k < TK])
@@ -456,11 +546,13 @@ def _schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
             is_start = jnp.concatenate(
                 [jnp.ones((1,), bool), spair[1:] != spair[:-1]])
             if use_ipa:
-                defer = defer | _rules_for(batch.raa, mu_raa, raa_uidx, k,
+                defer = defer | _rules_for(sb["batch"].raa, sb["mu_raa"],
+                                           sb["raa_uidx"], k,
                                            pair_ok, order, is_start,
                                            admit_cap, anti=True)
             if use_sph:
-                defer = defer | _rules_for(batch.spread, mu_sph, sph_uidx, k,
+                defer = defer | _rules_for(sb["batch"].spread, sb["mu_sph"],
+                                           sb["sph_uidx"], k,
                                            pair_ok, order, is_start,
                                            admit_cap, anti=False)
         if use_ipa:
@@ -472,96 +564,158 @@ def _schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
             # count path applies and co-admission is monotone-safe
             # (placements only add matches), so no deferral.
             earlier_any = jnp.cumsum(_f(admit_cap)) - _f(admit_cap)
-            live = ra_boot if boot_live is None else (ra_boot & boot_live)
+            live = (sb["ra_boot"] if boot_live is None
+                    else (sb["ra_boot"] & boot_live))
             defer = defer | (live & (earlier_any > 0))
         return defer
 
-    def cond(c):
-        return c["progress"] & (c["rounds"] < max_rounds)
-
-    def body(c):
-        unassigned = (c["assigned"] < 0) & batch.valid
+    def round_step(c, sb, capture_first: bool, windowed: bool = False):
+        """One propose/admit round over sb's rows (width W <= B; the full
+        round passes identity rows).  Updates the full-width carry through
+        mode='drop' scatters, so sentinel rows (>= B) are no-ops."""
+        rows = sb["rows"]
+        rsafe = jnp.clip(rows, 0, B - 1)
+        sbatch = sb["batch"]
+        unassigned = (jnp.take(c["assigned"], rsafe) < 0) & sb["valid"]
         cl = cluster_at(c)
-        feas, aff_unres, boot_live = feasibility(c, cl)
+        feas, aff_unres, boot_live = feasibility(c, cl, sb)
         feas = feas & unassigned[:, None]
 
         # scores against committed usage + placements so later rounds see
         # earlier rounds' pods (the batched analog of assume-before-next-pod)
-        scores, _ = run_scores(cl, batch, cfg, feas, affinity_ok,
-                               pre=score_pre)
+        scores, _ = run_scores(cl, sbatch, cfg, feas, sb["affinity_ok"],
+                               pre=sb["score_pre"])
 
         masked = jnp.where(feas, scores, _NEG)
         best = jnp.max(masked, axis=1)
         ties = (masked == best[:, None]) & feas
         logits = jnp.where(ties, 0.0, _NEG)
-        choice = jax.vmap(jax.random.categorical)(tie_keys, logits)
+        choice = jax.vmap(jax.random.categorical)(sb["tie_keys"], logits)
         active = jnp.any(feas, axis=1)
         prop = jnp.where(active, choice.astype(jnp.int32), N)  # N = no-op seg
 
-        # ---- admission: sort by proposed node (stable keeps pod order) ----
+        # ---- admission: sort by proposed node (stable keeps pod order;
+        # rows are ascending original indices, so sub-round order == the
+        # full round's order restricted to these pods) ----
         order = jnp.argsort(prop, stable=True)
         snode = prop[order]
         sactive = active[order]
         is_start = jnp.concatenate(
             [jnp.ones((1,), bool), snode[1:] != snode[:-1]])
 
-        sreq = batch.req[order] * _f(sactive)[:, None]          # [B, R]
+        sreq = sbatch.req[order] * _f(sactive)[:, None]         # [W, R]
         csum = jnp.cumsum(sreq, axis=0)
         excl = csum - sreq
         prefix_excl = excl - _segment_base(excl, is_start)      # earlier
         node_safe = jnp.clip(snode, 0, N - 1)                   # proposers'
         free = (cluster.allocatable[node_safe]                  # usage
                 - c["req"][node_safe])
-        cap_ok = K.fit_rows(batch.req[order], free - prefix_excl)
+        cap_ok = K.fit_rows(sbatch.req[order], free - prefix_excl)
 
         if use_ports:
-            sreg = batch.ports_asnode_hot[order] * _f(sactive)[:, None]
+            sreg = sbatch.ports_asnode_hot[order] * _f(sactive)[:, None]
             pcs = jnp.cumsum(sreg, axis=0)
             pexcl = pcs - sreg
             earlier_ports = pexcl - _segment_base(pexcl, is_start)
-            conflict = jnp.sum(batch.ports_hot[order] * earlier_ports,
+            conflict = jnp.sum(sbatch.ports_hot[order] * earlier_ports,
                                axis=1) > 0.5
             cap_ok = cap_ok & ~conflict
 
+        W = rows.shape[0]
         admit_sorted = cap_ok & sactive & (snode < N)
-        admit = jnp.zeros((B,), bool).at[order].set(admit_sorted)
+        admit = jnp.zeros((W,), bool).at[order].set(admit_sorted)
         if intra:
             # intra-round topology serialization (conservative; deferred
             # pods re-check against exact committed counts next round)
-            admit = admit & ~topology_deferral(admit, prop, boot_live)
+            admit = admit & ~topology_deferral(sb, admit, prop, boot_live)
 
         # ---- commit ----
         seg = jnp.where(admit, prop, N)
         add_req = jax.ops.segment_sum(
-            batch.req * _f(admit)[:, None], seg, num_segments=N + 1)[:N]
+            sbatch.req * _f(admit)[:, None], seg, num_segments=N + 1)[:N]
         add_nz = jax.ops.segment_sum(
-            batch.nonzero_req * _f(admit)[:, None], seg,
+            sbatch.nonzero_req * _f(admit)[:, None], seg,
             num_segments=N + 1)[:N]
         new = dict(c)
         new["req"] = c["req"] + add_req
         new["nz"] = c["nz"] + add_nz
         if use_ports:
             add_ports = jax.ops.segment_max(
-                batch.ports_asnode_hot * _f(admit)[:, None], seg,
+                sbatch.ports_asnode_hot * _f(admit)[:, None], seg,
                 num_segments=N + 1)[:N]
             new["ports_used"] = jnp.maximum(c["ports_used"], add_ports)
-        new["assigned"] = jnp.where(admit, prop, c["assigned"])
-        new["win_score"] = jnp.where(admit, best, c["win_score"])
-        new["feas0"] = jnp.where(c["rounds"] == 0, feas, c["feas0"])
-        if aff_unres is not None:
-            new["unres"] = jnp.where(c["rounds"] == 0,
-                                     c["unres"] | (aff_unres & base),
-                                     c["unres"])
+        new["assigned"] = c["assigned"].at[rows].set(
+            jnp.where(admit, prop, jnp.take(c["assigned"], rsafe)),
+            mode="drop")
+        new["win_score"] = c["win_score"].at[rows].set(
+            jnp.where(admit, best, jnp.take(c["win_score"], rsafe)),
+            mode="drop")
+        if capture_first:
+            new["feas0"] = jnp.where(c["rounds"] == 0, feas, c["feas0"])
+            if aff_unres is not None:
+                new["unres"] = jnp.where(c["rounds"] == 0,
+                                         c["unres"] | (aff_unres & base),
+                                         c["unres"])
+        admitted_any = jnp.any(admit)
         new["rounds"] = c["rounds"] + 1
-        new["progress"] = jnp.any(admit)
+        if windowed:
+            # retirement: a pod with NO feasible node in a no-admission
+            # round leaves the window-selection pool; any admission
+            # re-opens everyone's feasibility (affinity matches only
+            # accumulate), so the pool resets.  This keeps windowed rounds
+            # live: unschedulable pods at the head of the pool cannot pin
+            # the window forever.  Only FIRST-TIME retirements count as
+            # progress, or an all-unschedulable tail would re-retire
+            # forever and burn max_rounds.
+            new_retire = ((~active) & unassigned
+                          & ~jnp.take(c["retired"], rsafe))
+            new["retired"] = jnp.where(
+                admitted_any, jnp.zeros_like(c["retired"]),
+                c["retired"].at[rows].max(new_retire, mode="drop"))
+            new["progress"] = admitted_any | jnp.any(new_retire)
+        else:
+            new["progress"] = admitted_any
         return new
 
-    out = jax.lax.while_loop(cond, body, carry0)
+    fsb = full_sub()
+    use_window = bool(residual_window) and residual_window < B
+
+    if not use_window:
+        def cond(c):
+            return c["progress"] & (c["rounds"] < max_rounds)
+
+        def body(c):
+            return round_step(c, fsb, capture_first=True)
+
+        out = jax.lax.while_loop(cond, body, carry0)
+    elif max_rounds < 1:
+        out = carry0
+    else:
+        # phase A: one full-width round admits the uncontended bulk and
+        # captures feas0/unres; phase B loops over a residual WINDOW of the
+        # first residual_window still-unassigned pods — the same round math
+        # at ~W/B the FLOPs, since every in-round tensor row-gathers to W.
+        out = round_step(carry0, fsb, capture_first=True, windowed=True)
+
+        def condw(c):
+            pool = (c["assigned"] < 0) & batch.valid & ~c["retired"]
+            return (c["progress"] & jnp.any(pool)
+                    & (c["rounds"] < max_rounds))
+
+        def bodyw(c):
+            pool = (c["assigned"] < 0) & batch.valid & ~c["retired"]
+            rows = jnp.nonzero(pool, size=residual_window,
+                               fill_value=B)[0].astype(jnp.int32)
+            return round_step(c, gather_sub(rows), capture_first=False,
+                              windowed=True)
+
+        out = jax.lax.while_loop(condw, bodyw, out)
     unresolvable = out["unres"]
     all_unres = jnp.all(unresolvable | out["feas0"] | ~base, axis=1)
     n_feas = jnp.sum(out["feas0"].astype(jnp.int32), axis=1)
     packed = jnp.concatenate([out["assigned"], n_feas,
-                              all_unres.astype(jnp.int32)])
+                              all_unres.astype(jnp.int32),
+                              out["rounds"].reshape(1)])
     return GangResult(chosen=out["assigned"], score=out["win_score"],
                       rounds=out["rounds"], requested=out["req"],
                       nz=out["nz"], ports_used=out["ports_used"],

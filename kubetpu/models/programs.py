@@ -181,13 +181,33 @@ def explain_filters(cluster, batch, cfg: ProgramConfig, host_ok=None):
     return no_feasible, jnp.stack(blocking)
 
 
+STATIC_RAW_SCORES = {
+    # score plugins whose RAW scores are independent of the auction carry
+    # (requested usage and intra-batch placements): gang mode computes them
+    # once and re-normalizes per round against the evolving feasible mask
+    "ImageLocality": K.image_locality_score,
+    "NodeAffinity": K.node_affinity_score,
+    "NodePreferAvoidPods": K.prefer_avoid_pods_score,
+    "TaintToleration": K.taint_toleration_score,
+}
+
+
+def static_raw_scores(cluster, batch, cfg: ProgramConfig):
+    """Precompute the assignment-independent raw scores for run_scores'
+    pre dict (keyed "raw:<plugin>")."""
+    return {f"raw:{name}": fn(cluster, batch)
+            for name, fn in STATIC_RAW_SCORES.items()
+            if any(n == name for n, _ in cfg.scores)}
+
+
 def run_scores(cluster, batch, cfg: ProgramConfig, feasible, affinity_ok,
                pre=None):
     """Per-plugin normalized scores x weight, summed
     (reference: framework.go:579-656 RunScorePlugins).  pre: optional dict
     of precomputed assignment-independent match tensors (gang mode hoists
     them out of its round loop): keys "interpod_score", "spread_soft",
-    "default_spread"."""
+    "default_spread", and "raw:<plugin>" raw-score arrays from
+    static_raw_scores."""
     pre = pre or {}
     total = jnp.zeros(feasible.shape, jnp.float32)
     per_plugin: Dict[str, jnp.ndarray] = {}
@@ -195,7 +215,9 @@ def run_scores(cluster, batch, cfg: ProgramConfig, feasible, affinity_ok,
         if name == "NodeResourcesBalancedAllocation":
             s = K.balanced_allocation_score(cluster, batch)
         elif name == "ImageLocality":
-            s = K.image_locality_score(cluster, batch)
+            s = pre.get("raw:ImageLocality")
+            if s is None:
+                s = K.image_locality_score(cluster, batch)
         elif name == "InterPodAffinity":
             s = K.interpod_score(cluster, batch, feasible,
                                  pre=pre.get("interpod_score"),
@@ -205,10 +227,14 @@ def run_scores(cluster, batch, cfg: ProgramConfig, feasible, affinity_ok,
         elif name == "NodeResourcesMostAllocated":
             s = K.most_allocated_score(cluster, batch)
         elif name == "NodeAffinity":
-            s = K.default_normalize(K.node_affinity_score(cluster, batch),
-                                    feasible, reverse=False)
+            raw = pre.get("raw:NodeAffinity")
+            if raw is None:
+                raw = K.node_affinity_score(cluster, batch)
+            s = K.default_normalize(raw, feasible, reverse=False)
         elif name == "NodePreferAvoidPods":
-            s = K.prefer_avoid_pods_score(cluster, batch)
+            s = pre.get("raw:NodePreferAvoidPods")
+            if s is None:
+                s = K.prefer_avoid_pods_score(cluster, batch)
         elif name == "PodTopologySpread":
             s = K.spread_soft_score(cluster, batch, feasible, affinity_ok,
                                     cfg.hostname_topokey,
@@ -219,8 +245,10 @@ def run_scores(cluster, batch, cfg: ProgramConfig, feasible, affinity_ok,
                                          match_ns=pre.get("default_spread"))
             s = K.default_spread_normalize(cluster, batch, raw, feasible)
         elif name == "TaintToleration":
-            s = K.default_normalize(K.taint_toleration_score(cluster, batch),
-                                    feasible, reverse=True)
+            raw = pre.get("raw:TaintToleration")
+            if raw is None:
+                raw = K.taint_toleration_score(cluster, batch)
+            s = K.default_normalize(raw, feasible, reverse=True)
         elif name == "RequestedToCapacityRatio":
             # default shape already on the MaxNodeScore scale (the plugin
             # rescales config scores x10 at construction, see intree.py)

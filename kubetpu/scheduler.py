@@ -147,6 +147,8 @@ class Scheduler:
         # (block_until_ready does not block through the axon tunnel);
         # benchmarks read this for the honest host/device split
         self.device_wait_s = 0.0
+        # auction round count of the most recent gang cycle (diagnostics)
+        self.last_gang_rounds = 0
         self._async_binding = async_binding
         self._bind_pool = ThreadPoolExecutor(max_workers=16,
                                              thread_name_prefix="binder")
@@ -535,6 +537,9 @@ class Scheduler:
         chosen_full = packed[:B]
         if self.config.mode != "gang":
             self._next_start_node_index = int(packed[3 * B])
+        else:
+            # auction round count (diagnostics; bench reports it)
+            self.last_gang_rounds = int(packed[3 * B])
         chosen = chosen_full[:len(live)]
         n_feas = packed[B:2 * B][:len(live)]
         unres = packed[2 * B:3 * B][:len(live)].astype(bool)

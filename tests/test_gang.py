@@ -324,11 +324,12 @@ def test_packed_host_view_matches_fields():
     res = gang.run_auction(cluster, batch, cfg, rng)
     B = batch.valid.shape[0] if batch.valid.ndim else 0
     packed = np.asarray(res.packed)
-    assert packed.shape == (3 * B,)
+    assert packed.shape == (3 * B + 1,)
     assert np.array_equal(packed[:B], np.asarray(res.chosen))
     assert np.array_equal(packed[B:2 * B], np.asarray(res.n_feasible))
-    assert np.array_equal(packed[2 * B:].astype(bool),
+    assert np.array_equal(packed[2 * B:3 * B].astype(bool),
                           np.asarray(res.all_unresolvable))
+    assert packed[3 * B] == int(np.asarray(res.rounds))
 
 
 def test_adversarial_contention_bounded_rounds():
@@ -345,3 +346,75 @@ def test_adversarial_contention_bounded_rounds():
     assert_no_capacity_violation(cluster, batch, np.asarray(g.chosen))
     # rounds are bounded by the CONTENDED pod count, not the batch size
     assert int(g.rounds) <= 16 + 1
+
+
+def test_windowed_residual_parity_when_tail_fits_window():
+    """With residual_window >= the round-1 losers, every windowed round is
+    the full round restricted to the unassigned pods: placements must match
+    the full-width loop EXACTLY (same tie RNG streams, same admission
+    order)."""
+    nodes = [mknode(name=f"n{i}", pods="2") for i in range(4)]
+    pending = [mkpod(name=f"p{i:02d}") for i in range(16)]
+    cluster, batch, cfg, _ = build(nodes, {}, pending)
+    rng = jax.random.PRNGKey(5)
+    full = gang.schedule_gang(cluster, batch, cfg, rng, residual_window=0)
+    win = gang.schedule_gang(cluster, batch, cfg, rng, residual_window=12)
+    np.testing.assert_array_equal(np.asarray(full.chosen),
+                                  np.asarray(win.chosen))
+    np.testing.assert_array_equal(np.asarray(full.requested),
+                                  np.asarray(win.requested))
+
+
+def test_windowed_residual_small_window_contended():
+    """A window SMALLER than the contended tail still terminates, admits
+    exactly the available slots, and never over-commits capacity."""
+    nodes = [mknode(name=f"n{i}", pods="1") for i in range(4)]
+    pending = [mkpod(name=f"p{i:02d}") for i in range(16)]
+    cluster, batch, cfg, _ = build(nodes, {}, pending, scores=())
+    g = gang.schedule_gang(cluster, batch, cfg, jax.random.PRNGKey(0),
+                           residual_window=4)
+    chosen = np.asarray(g.chosen)[:16]
+    assert (chosen >= 0).sum() == 4
+    assert_no_capacity_violation(cluster, batch, np.asarray(g.chosen))
+    # progress bound: every round admits >=1 pod or retires >=1 pod
+    assert int(g.rounds) <= 16 + 4 + 2
+
+
+def test_windowed_no_topo_with_topology_scores():
+    """intra_batch_topology=False with InterPodAffinity/PodTopologySpread/
+    DefaultPodTopologySpread SCORE plugins must work in windowed rounds:
+    the score pres are hoisted independently of the intra flag (a width-W
+    sub-batch cannot fall back to full-size selector matching)."""
+    nodes = [mknode(name=f"n{i}", pods="2",
+                    labels={api.LABEL_ZONE: f"z{i % 2}"}) for i in range(4)]
+    pending = [mkpod(name=f"p{i:02d}", labels={"app": "a"})
+               for i in range(16)]
+    scores = (("InterPodAffinity", 1), ("PodTopologySpread", 2),
+              ("DefaultPodTopologySpread", 1),
+              ("NodeResourcesLeastAllocated", 1))
+    cluster, batch, cfg, _ = build(nodes, {}, pending, scores=scores)
+    g = gang.schedule_gang(cluster, batch, cfg, jax.random.PRNGKey(1),
+                           intra_batch_topology=False, residual_window=4)
+    chosen = np.asarray(g.chosen)[:16]
+    assert (chosen >= 0).sum() == 8  # 2 pod slots x 4 nodes
+    assert_no_capacity_violation(cluster, batch, np.asarray(g.chosen))
+
+
+def test_windowed_unschedulable_tail_terminates_quickly():
+    """Unschedulable pods at the head of the pool must retire, not pin the
+    window: rounds stay near the admission count, not max_rounds."""
+    # 12 schedulable pods + 4 that fit nowhere (huge cpu ask)
+    nodes = [mknode(name=f"n{i}", pods="4") for i in range(4)]
+    pending = []
+    for i in range(16):
+        if i % 4 == 0:
+            pending.append(mkpod(name=f"p{i:02d}", cpu="900"))
+        else:
+            pending.append(mkpod(name=f"p{i:02d}"))
+    cluster, batch, cfg, _ = build(nodes, {}, pending)
+    g = gang.schedule_gang(cluster, batch, cfg, jax.random.PRNGKey(2),
+                           residual_window=4)
+    chosen = np.asarray(g.chosen)[:16]
+    assert (chosen >= 0).sum() == 12
+    assert (chosen[::4] == -1).all()
+    assert int(g.rounds) < 12
