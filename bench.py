@@ -116,6 +116,7 @@ def run_mode(mode, n_nodes, n_pods, existing_per_node, repeats,
         for p in pending:
             store.add(p)
         sched.device_wait_s = 0.0
+        sched.device_flops = 0.0
         outcomes = []
         cycle_times = []
         cycle_rounds = []
@@ -142,6 +143,15 @@ def run_mode(mode, n_nodes, n_pods, existing_per_node, repeats,
         }
         if mode == "gang":
             stats["auction_rounds_max"] = max(cycle_rounds, default=0)
+            # analytic matmul-FLOP lower bound (kubetpu/utils/flops.py):
+            # achieved TFLOP/s over the readback-observed device time, MFU
+            # vs the chip's bf16 peak
+            from kubetpu.utils.flops import peak_flops_per_s
+            stats["device_tflop"] = round(sched.device_flops / 1e12, 3)
+            if sched.device_wait_s > 0:
+                ach = sched.device_flops / sched.device_wait_s
+                stats["achieved_tflops"] = round(ach / 1e12, 2)
+                stats["mfu_lower_bound"] = round(ach / peak_flops_per_s(), 4)
     if repeats == 0:
         best = first
     return best, first, outcomes, sched, stats

@@ -149,6 +149,8 @@ class Scheduler:
         self.device_wait_s = 0.0
         # auction round count of the most recent gang cycle (diagnostics)
         self.last_gang_rounds = 0
+        # cumulative analytic device FLOPs (utils/flops.py; gang mode only)
+        self.device_flops = 0.0
         self._async_binding = async_binding
         self._bind_pool = ThreadPoolExecutor(max_workers=16,
                                              thread_name_prefix="binder")
@@ -540,6 +542,10 @@ class Scheduler:
         else:
             # auction round count (diagnostics; bench reports it)
             self.last_gang_rounds = int(packed[3 * B])
+            from .utils.flops import gang_cycle_flops
+            self.device_flops += gang_cycle_flops(
+                cluster, batch, cfg, self.last_gang_rounds,
+                intra_batch_topology=needs_topo)
         chosen = chosen_full[:len(live)]
         n_feas = packed[B:2 * B][:len(live)]
         unres = packed[2 * B:3 * B][:len(live)].astype(bool)
