@@ -85,7 +85,8 @@ def _percentile(xs, q):
 
 
 def run_mode(mode, n_nodes, n_pods, existing_per_node, repeats,
-             mesh_shape=None, batch_cap=None, chain=None, ipa_heavy=False):
+             mesh_shape=None, batch_cap=None, chain=None, ipa_heavy=False,
+             pipeline=False):
     """One full e2e measurement: fresh store + scheduler per attempt; the
     first attempt pays XLA compiles (bounded by the persistent cache),
     later attempts reuse the in-process jit cache.  Pod counts above
@@ -111,7 +112,8 @@ def run_mode(mode, n_nodes, n_pods, existing_per_node, repeats,
         cfg = KubeSchedulerConfiguration(
             profiles=[KubeSchedulerProfile()],
             batch_size=min(n_pods, batch_cap), mode=mode,
-            mesh_shape=mesh_shape, chain_cycles=chain)
+            mesh_shape=mesh_shape, chain_cycles=chain,
+            pipeline_cycles=pipeline)
         sched = Scheduler(store, config=cfg, async_binding=False)
         for p in pending:
             store.add(p)
@@ -145,10 +147,12 @@ def run_mode(mode, n_nodes, n_pods, existing_per_node, repeats,
             stats["auction_rounds_max"] = max(cycle_rounds, default=0)
             # analytic matmul-FLOP lower bound (kubetpu/utils/flops.py):
             # achieved TFLOP/s over the readback-observed device time, MFU
-            # vs the chip's bf16 peak
+            # vs the chip's bf16 peak.  In pipelined mode device execution
+            # overlaps host work, so device_wait_s understates device time
+            # and would inflate these — report the FLOP count only.
             from kubetpu.utils.flops import peak_flops_per_s
             stats["device_tflop"] = round(sched.device_flops / 1e12, 3)
-            if sched.device_wait_s > 0:
+            if sched.device_wait_s > 0 and not pipeline:
                 ach = sched.device_flops / sched.device_wait_s
                 stats["achieved_tflops"] = round(ach / 1e12, 2)
                 stats["mfu_lower_bound"] = round(ach / peak_flops_per_s(), 4)
@@ -212,10 +216,12 @@ def chain_drain_case(n_nodes, n_pods, existing_per_node):
     justifies the feature (or its removal)."""
     out = {}
     cap = max(256, n_pods // 4)
-    for label, chain in (("chain_on", True), ("chain_off", False)):
+    for label, chain, pipe in (("pipelined", True, True),
+                               ("chain_on", True, False),
+                               ("chain_off", False, False)):
         best, first, outcomes, sched, stats = run_mode(
             "gang", n_nodes, n_pods, existing_per_node, repeats=1,
-            batch_cap=cap, chain=chain)
+            batch_cap=cap, chain=chain, pipeline=pipe)
         d, pods_per_sec = mode_summary("gang", best, first, outcomes, sched,
                                        stats)
         sched.close()
@@ -223,6 +229,8 @@ def chain_drain_case(n_nodes, n_pods, existing_per_node):
         out[label] = d
     on, off = out["chain_on"], out["chain_off"]
     out["speedup"] = round(off["e2e_best_s"] / max(on["e2e_best_s"], 1e-9), 3)
+    out["pipeline_speedup"] = round(
+        on["e2e_best_s"] / max(out["pipelined"]["e2e_best_s"], 1e-9), 3)
     out["batch_cap"] = cap
     return out
 

@@ -119,6 +119,18 @@ class KubeSchedulerConfiguration:
     # XLA cache this is a cache load; cold, it moves the first-cycle
     # compile out of the serving path (VERDICT r3 #7)
     prewarm: bool = True
+    # Double-buffered drain (gang + chain_cycles only): schedule_pending
+    # dispatches cycle k against the previous cycle's speculative on-device
+    # chained cluster BEFORE committing cycle k-1, so cycle k's device
+    # execution overlaps both the commit loop of k-1 and the tensorize of
+    # k+1 (SURVEY §7 "batched, donated, overlapped"; the reference's
+    # analog is the bind goroutine, scheduler.go:628).  Outcomes therefore
+    # LAG one cycle: each schedule_pending call returns the PREVIOUS
+    # dispatched cycle's outcomes, and a final call with an empty queue
+    # flushes the last in-flight cycle.  A commit failure or an
+    # unaccounted store event discards the speculative dispatch and
+    # re-runs that cycle against a rebuilt snapshot.
+    pipeline_cycles: bool = False
 
     def profile_for(self, name: str) -> Optional[KubeSchedulerProfile]:
         for p in self.profiles:

@@ -64,6 +64,29 @@ class ScheduleOutcome:
     preemption_may_help: bool = True
 
 
+@dataclass
+class PreparedCycle:
+    """Host-side state of one scheduling cycle between tensorize and
+    commit — the unit the pipelined drain keeps in flight."""
+    fwk: "Framework"
+    trace: Trace
+    chain_seq0: int
+    node_infos: list
+    states: Dict[str, CycleState]
+    live: list
+    pinfos: list
+    builder: SnapshotBuilder
+    cluster: object
+    batch: object
+    host_relevant: Dict[str, bool]
+    host_ok_dev: object
+    cfg: programs.ProgramConfig
+    cycle_ctx: object
+    needs_topo: bool = True
+    used_chain: bool = False
+    chain_pod_uids: list = field(default_factory=list)
+
+
 class Scheduler:
     """reference: scheduler.go:69."""
 
@@ -152,6 +175,8 @@ class Scheduler:
         # cumulative analytic device FLOPs (utils/flops.py; gang mode only)
         self.device_flops = 0.0
         self._async_binding = async_binding
+        # pipelined drain: the dispatched-but-uncommitted cycle (prep, res)
+        self._inflight_cycle = None
         self._bind_pool = ThreadPoolExecutor(max_workers=16,
                                              thread_name_prefix="binder")
         self._inflight_binds: List = []
@@ -307,10 +332,115 @@ class Scheduler:
             # extenders are a per-pod HTTP round trip; keep the reference's
             # strictly serial semantics (scheduler.go:510 pops one pod)
             max_batch = 1
+        if (self.config.pipeline_cycles and not self.extenders
+                and self.config.mode == "gang" and self._mesh is None
+                and getattr(self.config, "chain_cycles", False)):
+            return self._schedule_pipelined(max_batch, timeout)
         batch = self.queue.pop_batch(max_batch, timeout=timeout)
         if not batch:
             return []
         return self._schedule_batch(batch)
+
+    def _schedule_pipelined(self, max_batch: int,
+                            timeout: float) -> List[ScheduleOutcome]:
+        """Double-buffered drain: dispatch cycle k against the previous
+        cycle's SPECULATIVE chained cluster before committing cycle k-1, so
+        k's device execution overlaps both k-1's commit loop and k+1's
+        tensorize (the next call's prepare).  Outcomes lag one cycle; an
+        empty pop flushes the in-flight cycle.  If committing k-1 fails (or
+        invalidates the chain), the speculative dispatch of k is discarded
+        and k re-runs against a rebuilt snapshot — placements never diverge
+        from the non-pipelined path's guarantees."""
+        returned: List[ScheduleOutcome] = []
+        cycle_start = time.time()
+        while True:
+            # never block the pop while a finished cycle awaits its commit
+            # — flushing late delays binds and distorts drain timing
+            qpods = self.queue.pop_batch(
+                max_batch,
+                timeout=0.0 if self._inflight_cycle is not None else timeout)
+            prev = self._inflight_cycle
+            self._inflight_cycle = None
+
+            by_profile: Dict[str, List[QueuedPodInfo]] = {}
+            for qp in qpods:
+                if self._skip_pod_schedule(qp.pod):
+                    continue
+                by_profile.setdefault(qp.pod.spec.scheduler_name,
+                                      []).append(qp)
+            if len(by_profile) != 1:
+                # multi-profile batches (or nothing schedulable) fall back
+                # to the synchronous path; flush the in-flight cycle first
+                outcomes = self._finish_group(*prev) if prev else []
+                for name, group in by_profile.items():
+                    outcomes.extend(self._schedule_group(
+                        self.profiles[name], group))
+                outcomes = returned + outcomes
+                if self.metrics and outcomes:
+                    self.metrics.observe_cycle(len(outcomes),
+                                               time.time() - cycle_start)
+                return outcomes
+            (name, group), = by_profile.items()
+            fwk = self.profiles[name]
+            # prepare k: host tensorize work that overlaps cycle k-1's
+            # device execution (the real overlap — the tunnel serves
+            # transfers FIFO behind queued programs, so everything after
+            # the readback below is serialized with the device)
+            prep, early = self._prepare_group(fwk, group)
+            if prep is None:
+                return (returned + early
+                        + (self._finish_group(*prev) if prev else []))
+            if prev is not None and not prep.used_chain:
+                # chain break (event landed / vocab overflow / bucket
+                # compaction): a fresh rebuild while k-1 is uncommitted
+                # would miss its placements and could oversubscribe nodes.
+                # Serialize: commit k-1 first, then re-tensorize with its
+                # placements in the cache.  Re-prepare only the pods that
+                # SURVIVED the first prepare — pods already failed there
+                # have final outcomes in `early`, and re-running _fail
+                # would duplicate events and preemption attempts.
+                returned += self._finish_group(*prev)
+                prev = None
+                prep, early2 = self._prepare_group(fwk, prep.live)
+                early += early2
+                if prep is None:
+                    return returned + early
+            # readback k-1 BEFORE dispatching k (FIFO tunnel), then
+            # dispatch k, then run k-1's commit loop while k executes
+            packed_prev = self._readback_group(*prev) if prev else None
+            res = self._dispatch_group(
+                prep, extra_uncommitted=(prev[0].batch.valid.shape[0]
+                                         if prev else 0))
+            self._last_commit_failed = False
+            outcomes = (self._commit_group(prev[0], packed_prev)
+                        if prev else [])
+            if prep.used_chain and self._last_commit_failed:
+                # committing k-1 failed: this cycle was dispatched against
+                # a chain whose placements never materialized.  Discard
+                # and re-run synchronously over the surviving pods only
+                # (already-failed pods' outcomes in `early` are final)
+                prep, early2 = self._prepare_group(fwk, prep.live)
+                early += early2
+                if prep is None:
+                    return returned + outcomes + early
+                res = self._dispatch_group(prep)
+            self._inflight_cycle = (prep, res)
+            returned += outcomes + early
+            if returned:
+                if self.metrics:
+                    self.metrics.observe_cycle(len(returned),
+                                               time.time() - cycle_start)
+                return returned
+            # pipe just primed (first cycle dispatched, nothing committed
+            # yet): loop to pop the next batch so this call still returns
+            # outcomes — "[] means no work" stays true for drain loops
+
+    def flush_pipeline(self) -> List[ScheduleOutcome]:
+        """Commit any in-flight pipelined cycle (used at shutdown and by
+        callers that need every outcome materialized now)."""
+        prev = self._inflight_cycle
+        self._inflight_cycle = None
+        return self._finish_group(*prev) if prev else []
 
     def _schedule_batch(self, qpods: List[QueuedPodInfo]) -> List[ScheduleOutcome]:
         start = time.time()
@@ -340,6 +470,20 @@ class Scheduler:
 
     def _schedule_group(self, fwk: Framework,
                         qpods: List[QueuedPodInfo]) -> List[ScheduleOutcome]:
+        prep, outcomes = self._prepare_group(fwk, qpods)
+        if prep is None:
+            return outcomes
+        if self.extenders:
+            return outcomes + self._schedule_with_extenders(
+                fwk, prep.live, prep.states, prep.node_infos, prep.cluster,
+                prep.batch, prep.cfg, prep.host_ok_dev, prep.cycle_ctx)
+        res = self._dispatch_group(prep)
+        return outcomes + self._finish_group(prep, res)
+
+    def _prepare_group(self, fwk: Framework, qpods: List[QueuedPodInfo]):
+        """Host half of a cycle, up to (but excluding) the device dispatch:
+        snapshot, PreFilter, tensorize-or-chain, host filter masks,
+        nominated overlay.  Returns (PreparedCycle | None, early outcomes)."""
         trace = Trace("Scheduling", profile=fwk.profile_name,
                       pods=len(qpods))
         # capture the event sequence BEFORE snapshotting: a chain is only
@@ -372,13 +516,13 @@ class Scheduler:
             states[qp.pod.uid] = state
             live.append(qp)
         if not live:
-            return outcomes
+            return None, outcomes
         if n_nodes == 0:
             for qp in live:
                 outcomes.append(self._fail(fwk, qp, states[qp.pod.uid], "",
                                            "0/0 nodes are available",
                                            preemption_may_help=False))
-            return outcomes
+            return None, outcomes
 
         # ---- tensorize, or reuse the CHAINED cluster: the previous gang
         # cycle's materialized tensors already ARE this snapshot (no
@@ -477,24 +621,42 @@ class Scheduler:
                               if uid}
         trace.step("Tensorizing snapshot and pod batch done")
 
-        if self.extenders:
-            return outcomes + self._schedule_with_extenders(
-                fwk, live, states, node_infos, cluster, batch, cfg,
-                host_ok_dev, cycle_ctx)
+        from .framework.types import pod_with_affinity
+        # per-round topology re-evaluation only pays off when some pod
+        # actually carries topology terms; a term-free batch takes the
+        # cheaper static path (round-0 verdicts are provably invariant)
+        needs_topo = (any(pod_with_affinity(qp.pod)
+                          or qp.pod.spec.topology_spread_constraints
+                          for qp in live)
+                      # service/RC replicas score via
+                      # DefaultPodTopologySpread even without explicit
+                      # terms — they need intra-batch placements too
+                      or any(s is not None for s in spread_sels))
+        prep = PreparedCycle(
+            fwk=fwk, trace=trace, chain_seq0=chain_seq0,
+            node_infos=node_infos, states=states, live=live, pinfos=pinfos,
+            builder=builder, cluster=cluster, batch=batch,
+            host_relevant=host_relevant, host_ok_dev=host_ok_dev, cfg=cfg,
+            cycle_ctx=cycle_ctx, needs_topo=needs_topo,
+            used_chain=use_chain, chain_pod_uids=chain_pod_uids)
+        return prep, outcomes
 
+    def _dispatch_group(self, prep: PreparedCycle, extra_uncommitted: int = 0):
+        """Device dispatch of a prepared cycle (async through the tunnel),
+        plus the speculative chain materialize so the NEXT cycle can
+        tensorize against this cycle's placements before they commit.
+        extra_uncommitted: pods dispatched in earlier cycles whose commits
+        (and so cache.pod_count()) have not landed yet — the pipelined
+        drain passes the in-flight cycle's batch size so the chain bucket
+        guard sees the same fresh-rebuild estimate the synchronous path
+        would."""
+        fwk, cluster, batch, cfg = (prep.fwk, prep.cluster, prep.batch,
+                                    prep.cfg)
+        host_ok_dev, cycle_ctx = prep.host_ok_dev, prep.cycle_ctx
+        n_nodes = len(prep.node_infos)
         # ---- device: one program for the whole group (scan or auction)
         if self.config.mode == "gang":
-            from .framework.types import pod_with_affinity
-            # per-round topology re-evaluation only pays off when some pod
-            # actually carries topology terms; a term-free batch takes the
-            # cheaper static path (round-0 verdicts are provably invariant)
-            needs_topo = (any(pod_with_affinity(qp.pod)
-                              or qp.pod.spec.topology_spread_constraints
-                              for qp in live)
-                          # service/RC replicas score via
-                          # DefaultPodTopologySpread even without explicit
-                          # terms — they need intra-batch placements too
-                          or any(s is not None for s in spread_sels))
+            needs_topo = prep.needs_topo
             if self._mesh is not None:
                 from .parallel import mesh as pmesh
                 res = pmesh.sharded_schedule_gang(
@@ -528,14 +690,85 @@ class Scheduler:
                         fwk.hard_pod_affinity_weight),
                     host_ok=host_ok_dev,
                     start_index=start)
-        # ONE device->host readback per cycle: the packed [3B(+1)] i32 view
-        # (chosen | n_feasible | all_unresolvable | seq: next_start).  The
-        # tunnel pays ~100 ms latency per transfer, so everything the host
-        # needs rides one small array; the big tensors (requested, masks)
-        # stay on device for chaining / lazy preemption verdicts.
+        # request the packed readback transfer BEFORE enqueueing the chain
+        # materialize: the tunnel serves FIFO, so a transfer requested
+        # after materialize would wait for it — this way the readback
+        # completes right after the auction and the materialize overlaps
+        # the host's commit loop
+        try:
+            res.packed.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+        # ---- speculative chain (gang only): materialize this cycle's
+        # placements into the next cycle's cluster NOW, on device, so the
+        # pipelined drain can tensorize+dispatch cycle k+1 while this
+        # cycle's commit loop runs.  _finish_group discards it if a commit
+        # fails (the device-side placements then diverged from reality).
+        chain_ok = self.config.mode == "gang" and self._chain_enabled(fwk)
+        if chain_ok:
+            from .utils.intern import pow2_bucket
+            B_cap = batch.valid.shape[0]
+            p_next = int(cluster.pod_valid.shape[0]) + B_cap
+            # never chain into a BIGGER pod-axis bucket than a fresh
+            # rebuild would use: pow2 slack compounds across cycles
+            # (bucket + B -> next bucket) and a rebuild compacts it —
+            # chaining past this line doubles HBM for nothing.  (Estimated
+            # pre-commit: cache.pod_count() excludes this cycle's assumes,
+            # so allow one batch of slack plus any in-flight cycle's.)
+            fresh_p = pow2_bucket(self.cache.pod_count() + extra_uncommitted
+                                  + 2 * B_cap)
+            if pow2_bucket(p_next) > fresh_p:
+                chain_ok = False
+        if chain_ok:
+            from .models.gang import materialize_assigned
+            ta = batch.raa.valid.shape[1]
+            e_next = int(cluster.filter_terms.valid.shape[0]) + B_cap * ta
+            next_cluster = materialize_assigned(
+                cluster, batch, res.chosen,
+                res.requested, res.nz, res.ports_used,
+                pad_pods_to=pow2_bucket(p_next),
+                pad_terms_to=pow2_bucket(e_next),
+                extend_score_terms=True,
+                hard_pod_affinity_weight=float(
+                    fwk.hard_pod_affinity_weight))
+            uids = list(prep.chain_pod_uids)
+            uids.extend(pi.pod.uid for pi in prep.pinfos)
+            uids.extend([None] * (B_cap - len(prep.pinfos)))  # batch padding
+            uids.extend([None] * (pow2_bucket(p_next) - len(uids)))
+            self._chain = dict(builder=prep.builder, cluster=next_cluster,
+                               pod_uids=uids, seq=prep.chain_seq0,
+                               caps=_vocab_caps(prep.builder.table),
+                               profile=fwk.profile_name, n_nodes=n_nodes)
+        elif self.config.mode == "gang":
+            self._chain = None
+        return res
+
+    def _finish_group(self, prep: PreparedCycle, res) -> List[ScheduleOutcome]:
+        """Readback + commit half of a cycle.  The packed readback is the
+        cycle's ONLY device->host sync point."""
+        return self._commit_group(prep, self._readback_group(prep, res))
+
+    def _readback_group(self, prep: PreparedCycle, res) -> np.ndarray:
+        """ONE device->host readback per cycle: the packed [3B+1] i32 view
+        (chosen | n_feasible | all_unresolvable | rounds / next_start).
+        The tunnel pays ~100 ms latency per transfer AND serves transfers
+        FIFO behind queued programs, so the pipelined drain must issue this
+        BEFORE dispatching the next cycle; everything the host needs rides
+        one small array — the big tensors (requested, masks) stay on
+        device for chaining / lazy preemption verdicts."""
         t_dev = time.time()
         packed = np.asarray(res.packed)
         self.device_wait_s += time.time() - t_dev
+        return packed
+
+    def _commit_group(self, prep: PreparedCycle,
+                      packed: np.ndarray) -> List[ScheduleOutcome]:
+        fwk, trace = prep.fwk, prep.trace
+        live, states, pinfos = prep.live, prep.states, prep.pinfos
+        node_infos, cycle_ctx = prep.node_infos, prep.cycle_ctx
+        n_nodes = len(node_infos)
+        B = prep.batch.valid.shape[0]
+        outcomes: List[ScheduleOutcome] = []
         chosen_full = packed[:B]
         if self.config.mode != "gang":
             self._next_start_node_index = int(packed[3 * B])
@@ -544,8 +777,8 @@ class Scheduler:
             self.last_gang_rounds = int(packed[3 * B])
             from .utils.flops import gang_cycle_flops
             self.device_flops += gang_cycle_flops(
-                cluster, batch, cfg, self.last_gang_rounds,
-                intra_batch_topology=needs_topo)
+                prep.cluster, prep.batch, prep.cfg, self.last_gang_rounds,
+                intra_batch_topology=prep.needs_topo)
         chosen = chosen_full[:len(live)]
         n_feas = packed[B:2 * B][:len(live)]
         unres = packed[2 * B:3 * B][:len(live)].astype(bool)
@@ -568,7 +801,7 @@ class Scheduler:
             node_name = node_infos[int(chosen[i])].node_name
             outcome = self._commit(fwk, qp, state, node_name,
                                    int(n_feas[i]), pinfo=pinfos[i],
-                                   host_relevant=host_relevant[qp.pod.uid])
+                                   host_relevant=prep.host_relevant[qp.pod.uid])
             if outcome.node:
                 # preemption for pods failing later in this batch must see
                 # this placement (CycleContext.cluster_now overlay)
@@ -583,42 +816,11 @@ class Scheduler:
             outcomes[idx] = self._fail(fwk, qp, state, "", msg,
                                        preemption_may_help=mh,
                                        cycle=cycle_ctx)
-        # ---- chain the materialized cluster into the next cycle (gang
-        # only; a commit-path failure means the device-side placements
-        # diverged from reality, so the chain cannot be trusted)
-        chain_ok = self._chain_enabled(fwk) and not commit_failed
-        if chain_ok:
-            from .utils.intern import pow2_bucket
-            B_cap = batch.valid.shape[0]
-            p_next = int(cluster.pod_valid.shape[0]) + B_cap
-            # never chain into a BIGGER pod-axis bucket than a fresh
-            # rebuild would use: pow2 slack compounds across cycles
-            # (bucket + B -> next bucket) and a rebuild compacts it —
-            # chaining past this line doubles HBM for nothing
-            fresh_p = pow2_bucket(self.cache.pod_count() + B_cap)
-            if pow2_bucket(p_next) > fresh_p:
-                chain_ok = False
-        if chain_ok:
-            from .models.gang import materialize_assigned
-            ta = batch.raa.valid.shape[1]
-            e_next = int(cluster.filter_terms.valid.shape[0]) + B_cap * ta
-            next_cluster = materialize_assigned(
-                cluster, batch, res.chosen,
-                res.requested, res.nz, res.ports_used,
-                pad_pods_to=pow2_bucket(p_next),
-                pad_terms_to=pow2_bucket(e_next),
-                extend_score_terms=True,
-                hard_pod_affinity_weight=float(
-                    fwk.hard_pod_affinity_weight))
-            uids = list(chain_pod_uids)
-            uids.extend(pi.pod.uid for pi in pinfos)
-            uids.extend([None] * (B_cap - len(pinfos)))  # batch padding
-            uids.extend([None] * (pow2_bucket(p_next) - len(uids)))
-            self._chain = dict(builder=builder, cluster=next_cluster,
-                               pod_uids=uids, seq=chain_seq0,
-                               caps=_vocab_caps(builder.table),
-                               profile=fwk.profile_name, n_nodes=n_nodes)
-        elif self.config.mode == "gang":
+        # a commit-path failure invalidates the speculative chain (and any
+        # later cycle already dispatched against it — the pipelined drain
+        # reads _last_commit_failed and re-runs that cycle)
+        self._last_commit_failed = commit_failed
+        if commit_failed and self.config.mode == "gang":
             self._chain = None
         trace.step("Committing placements done")
         trace.log_if_long()
@@ -1136,6 +1338,10 @@ class Scheduler:
 
     def close(self) -> None:
         self._stop.set()
+        try:
+            self.flush_pipeline()
+        except Exception:
+            pass
         self.queue.close()
         self.cache.close()
         self._bind_pool.shutdown(wait=False)
