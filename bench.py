@@ -235,88 +235,146 @@ def chain_drain_case(n_nodes, n_pods, existing_per_node):
     return out
 
 
-def rescore_case(n_pods=102400, n_nodes=10240, chunk=16384):
-    """North star: 100k x 10k STREAMING RESCORE (BASELINE.md "autoscaler
-    simulate"): filter+score+select every pending pod against the live
-    cluster, no binding.  Pods stream through the device in fixed chunks
-    (static shapes); per chunk the host reads back ONE [3B] packed array.
-    Reports pods/s and the device HBM footprint."""
-    import jax
+def pv_heavy_case(n_nodes=1000, n_pods=2048):
+    """PVC-heavy workload at >=1000 nodes (VERDICT r4 #4): every pod mounts
+    a bound in-tree PV (zone-labeled, so VolumeZone really filters) plus a
+    direct EBS volume (so the limits family counts).  The volume family
+    runs as the device-side [B, N] mask (kubetpu/state/volumes.py); before
+    it, this workload cost B x N Python filter calls per cycle."""
+    import random
 
     from kubetpu.api import types as api
-    from kubetpu.framework.types import PodInfo
-    from kubetpu.models import programs
-    from kubetpu.models.batch import PodBatchBuilder
-    from kubetpu.state.tensors import SnapshotBuilder
-    from kubetpu.harness import hollow
     from kubetpu.client.store import ClusterStore
+    from kubetpu.harness import hollow
+    from kubetpu.scheduler import Scheduler
+    from kubetpu.apis.config import (KubeSchedulerConfiguration,
+                                     KubeSchedulerProfile)
 
-    store, pending = build_world(n_nodes, n_pods=0, existing_per_node=1)
-    pending = hollow.make_pods(chunk, prefix="re-", group_labels=64)
-    for i, p in enumerate(pending):
-        if i % 3 == 0:
-            hollow.with_spread(p, api.LABEL_ZONE, when="ScheduleAnyway")
-        if i % 5 == 0:
-            hollow.with_anti_affinity(p, api.LABEL_HOSTNAME)
+    def world():
+        rng = random.Random(0)
+        zones = [f"zone-{i}" for i in range(8)]
+        store = ClusterStore()
+        for n in hollow.make_nodes(n_nodes, zones=8):
+            n.status.allocatable["attachable-volumes-aws-ebs"] = "39"
+            store.add(n)
+        pending = hollow.make_pods(n_pods, prefix="pv-", group_labels=16)
+        for i, p in enumerate(pending):
+            zone = rng.choice(zones)
+            store.add(api.PersistentVolume(
+                metadata=api.ObjectMeta(name=f"pv-{i}",
+                                        labels={api.LABEL_ZONE: zone})))
+            store.add(api.PersistentVolumeClaim(
+                metadata=api.ObjectMeta(name=f"claim-{i}"),
+                volume_name=f"pv-{i}"))
+            p.spec.volumes = [
+                api.Volume(name="data",
+                           persistent_volume_claim=f"claim-{i}"),
+                api.Volume(name="scratch",
+                           aws_elastic_block_store=f"ebs-{i % 512}"),
+            ]
+        return store, pending
+
+    best = None
+    stats = {}
+    sched = None
+    for attempt in range(2):
+        if sched is not None:
+            sched.close()
+        s2, pending = world()
+        sched = Scheduler(s2, config=KubeSchedulerConfiguration(
+            profiles=[KubeSchedulerProfile()], batch_size=n_pods,
+            mode="gang", chain_cycles=True), async_binding=False)
+        for p in pending:
+            s2.add(p)
+        sched.device_wait_s = 0.0
+        t0 = time.time()
+        outcomes = []
+        while True:
+            got = sched.schedule_pending(timeout=0.2)
+            if not got:
+                break
+            outcomes.extend(got)
+        dt = time.time() - t0
+        if best is None or dt < best:
+            best = dt
+            stats = {
+                "nodes": n_nodes, "pods": n_pods,
+                "e2e_best_s": round(dt, 3),
+                "scheduled": sum(1 for o in outcomes if o.node),
+                "device_wait_s": round(sched.device_wait_s, 3),
+                "host_share": round(1.0 - sched.device_wait_s
+                                    / max(dt, 1e-9), 3),
+                "pods_per_sec": round(len(outcomes) / dt, 1),
+            }
+    sched.close()
+    return stats
+
+
+def rescore_case(n_pods=102400, n_nodes=10240, chunk=4096):
+    """North star: 100k x 10k STREAMING drain (BASELINE.md "autoscaler
+    simulate") — now with HONEST semantics (VERDICT r4 #3): every chunk is
+    DISTINCT pods, per-chunk tensorize is on the clock, and placements
+    COMMIT between chunks so capacity and topology counts evolve (pods in
+    chunk k see chunks < k exactly as the serial scheduler would).  This
+    is simply the full serving path: store -> queue -> pipelined chained
+    gang drain in `chunk`-pod cycles, one packed readback per cycle.
+
+    The existing-pod axis genuinely grows to ~n_pods by the end — the
+    per-cycle cost of the same-pair topology matmuls grows with it, which
+    is the honest physics of a cluster that ends the drain with 100k bound
+    pods.  Reports per-cycle p50/p99 and end-to-end pods/s."""
+    import jax
 
     from kubetpu.scheduler import Scheduler
     from kubetpu.apis.config import (KubeSchedulerConfiguration,
                                      KubeSchedulerProfile)
-    sched = Scheduler(store, config=KubeSchedulerConfiguration(
-        profiles=[KubeSchedulerProfile()]), async_binding=False)
-    sched.cache.update_snapshot(sched.snapshot)
-    node_infos = sched.snapshot.node_info_list
-    fwk = next(iter(sched.profiles.values()))
-    pinfos = [PodInfo(p) for p in pending]
-    sb = SnapshotBuilder(hard_pod_affinity_weight=fwk.hard_pod_affinity_weight)
-    sb.intern_pending(pinfos)
-    cluster = sb.build(node_infos).to_device()
-    batch = jax.tree.map(np.asarray, PodBatchBuilder(sb.table).build(pinfos))
-    from kubetpu.scheduler import Scheduler as _S
-    cfg = programs.ProgramConfig(
-        filters=fwk.tensor_filters, scores=fwk.tensor_scores,
-        hostname_topokey=max(sb.table.topokey.get(api.LABEL_HOSTNAME), 0),
-        plugin_args=fwk.tensor_plugin_args(sb.table),
-        active_topo_keys=_S._batch_topo_keys(sb.table, pinfos))
 
-    @jax.jit
-    def rescore(cluster, batch, rng):
-        res, chosen = programs.schedule_batch(cluster, batch, cfg, rng)
-        return jax.numpy.concatenate(
-            [chosen, res.feasible.sum(axis=1).astype(jax.numpy.int32)])
-
-    rng = jax.random.PRNGKey(0)
-    n_chunks = (n_pods + chunk - 1) // chunk
-    # compile pass
-    t0 = time.time()
-    np.asarray(rescore(cluster, batch, rng))
-    compile_s = time.time() - t0
-    t0 = time.time()
-    placed = 0
-    for c in range(n_chunks):
-        packed = np.asarray(rescore(cluster, batch,
-                                    jax.random.fold_in(rng, c)))
-        placed += int((packed[:chunk] >= 0).sum())
-    dt = time.time() - t0
-    mem = jax.local_devices()[0].memory_stats() or {}
-    # the axon runtime exposes no memory_stats; fall back to an analytic
-    # footprint: resident cluster + batch tensors plus the program's
-    # dominant [B, N] f32 transients (feasible/unresolvable/scores/ties)
-    def tree_bytes(t):
-        return int(sum(x.nbytes for x in jax.tree.leaves(t)
-                       if hasattr(x, "nbytes")))
-    resident = tree_bytes(cluster) + tree_bytes(batch)
-    transient = 6 * chunk * cluster.allocatable.shape[0] * 4
-    sched.close()
-    return {
-        "pods": n_pods, "nodes": n_nodes, "chunk": chunk,
-        "e2e_s": round(dt, 3), "compile_s": round(compile_s, 1),
-        "pods_per_sec": round(n_pods / dt, 1),
-        "placed_per_chunk": placed // n_chunks,
-        "hbm_peak_bytes": int(mem.get("peak_bytes_in_use", 0)),
-        "hbm_resident_est_bytes": resident,
-        "hbm_transient_est_bytes": transient,
-    }
+    out = {}
+    first_e2e = None
+    for attempt in range(2):   # attempt 0 pays the P-bucket compile ladder
+        store, pending = build_world(n_nodes, n_pods, existing_per_node=1)
+        cfg = KubeSchedulerConfiguration(
+            profiles=[KubeSchedulerProfile()], batch_size=chunk, mode="gang",
+            chain_cycles=True, pipeline_cycles=True)
+        sched = Scheduler(store, config=cfg, async_binding=False)
+        for p in pending:
+            store.add(p)
+        sched.device_wait_s = 0.0
+        sched.device_flops = 0.0
+        outcomes = []
+        cycle_times = []
+        t0 = time.time()
+        while True:
+            tc = time.time()
+            got = sched.schedule_pending(timeout=0.2)
+            if not got:
+                break
+            cycle_times.append(time.time() - tc)
+            outcomes.extend(got)
+        dt = time.time() - t0
+        scheduled = sum(1 for o in outcomes if o.node)
+        mem = jax.local_devices()[0].memory_stats() or {}
+        if attempt == 0:
+            first_e2e = dt
+        out = {
+            "pods": n_pods, "nodes": n_nodes, "chunk": chunk,
+            "semantics": "distinct pods/chunk, tensorize on-clock, "
+                         "placements committed between chunks",
+            "e2e_s": round(dt, 3),
+            "first_run_s": round(first_e2e, 3),
+            "cycles": len(cycle_times),
+            "cycle_p50_s": round(_percentile(cycle_times, 0.5), 3),
+            "cycle_p99_s": round(_percentile(cycle_times, 0.99), 3),
+            "device_wait_s": round(sched.device_wait_s, 3),
+            "device_tflop": round(sched.device_flops / 1e12, 3),
+            "pods_per_sec": round(len(outcomes) / dt, 1),
+            "scheduled": scheduled,
+            "hbm_peak_bytes": int(mem.get("peak_bytes_in_use", 0)),
+        }
+        if scheduled < len(outcomes):
+            out["unscheduled"] = len(outcomes) - scheduled
+        sched.close()
+    return out
 
 
 def main() -> None:
@@ -377,6 +435,12 @@ def main() -> None:
         except Exception as e:  # pragma: no cover - depends on device state
             detail["chain_drain"] = {"error": repr(e)}
 
+    if os.environ.get("BENCH_PV", "1") == "1" and mesh_shape is None:
+        try:
+            detail["pv_heavy"] = pv_heavy_case()
+        except Exception as e:  # pragma: no cover - depends on device state
+            detail["pv_heavy"] = {"error": repr(e)}
+
     if full:
         northstar = {}
         try:
@@ -386,7 +450,7 @@ def main() -> None:
             # loop's real shape anyway
             best, first, outcomes, sched, stats = run_mode(
                 "gang", 5120, 10240, 1, repeats=1, batch_cap=4096,
-                ipa_heavy=True)
+                ipa_heavy=True, pipeline=True)
             d, pods_per_sec = mode_summary("gang", best, first, outcomes,
                                            sched, stats)
             d["pods_per_sec"] = round(pods_per_sec, 1)

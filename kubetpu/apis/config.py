@@ -129,7 +129,12 @@ class KubeSchedulerConfiguration:
     # dispatched cycle's outcomes, and a final call with an empty queue
     # flushes the last in-flight cycle.  A commit failure or an
     # unaccounted store event discards the speculative dispatch and
-    # re-runs that cycle against a rebuilt snapshot.
+    # re-runs that cycle against a rebuilt snapshot; batches needing host
+    # filter masks (volume pods) serialize on the in-flight commit, so
+    # placements match the synchronous drain.  Known one-cycle lag: the
+    # nominated-pods overlay sees preemption nominations from cycle k-1
+    # only at cycle k+1 (nominations only shrink retry feasibility, never
+    # correctness of committed placements).
     pipeline_cycles: bool = False
 
     def profile_for(self, name: str) -> Optional[KubeSchedulerProfile]:

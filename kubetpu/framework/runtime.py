@@ -159,8 +159,13 @@ class Framework:
                 return st
         return Status.success()
 
-    def has_relevant_host_filters(self, pod: api.Pod) -> bool:
-        return any(self._relevant(p, pod) for p in self.host_filter_plugins)
+    def has_relevant_host_filters(self, pod: api.Pod,
+                                  exclude=frozenset()) -> bool:
+        """exclude: plugin names whose verdicts something else already
+        covers (the scheduler's device-side volume mask passes the covered
+        set so fully-covered pods skip the per-node Python filter loop)."""
+        return any(self._relevant(p, pod) for p in self.host_filter_plugins
+                   if p.name() not in exclude)
 
     def run_pre_score_plugins(self, state: CycleState, pod: api.Pod,
                               nodes: List[api.Node]) -> Status:

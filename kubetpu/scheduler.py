@@ -382,6 +382,17 @@ class Scheduler:
                 return outcomes
             (name, group), = by_profile.items()
             fwk = self.profiles[name]
+            if prev is not None and any(
+                    fwk.has_relevant_host_filters(qp.pod) for qp in group):
+                # host filter masks and the volume overlay are built from
+                # the CACHE, which excludes the uncommitted in-flight
+                # cycle's placements — preparing now could pass a node the
+                # in-flight cycle just filled (e.g. its last attachable
+                # volume), diverging from the synchronous drain.  Commit
+                # first; volume-less batches (the fast path) keep the
+                # overlap.
+                returned += self._finish_group(*prev)
+                prev = None
             # prepare k: host tensorize work that overlaps cycle k-1's
             # device execution (the real overlap — the tunnel serves
             # transfers FIFO behind queued programs, so everything after
@@ -570,11 +581,30 @@ class Scheduler:
         # predicate — measurable at 4k pods/cycle).
         host_relevant = {qp.pod.uid: fwk.has_relevant_host_filters(qp.pod)
                          for qp in live}
+        # the volume family evaluates ON DEVICE (state/volumes.py): one
+        # jitted [B, N] mask replaces ~B x N Python filter calls for
+        # PVC-heavy batches.  The host plugins still run at commit time
+        # (host_relevant above), preserving intra-batch race checks.
+        from .state.volumes import (DEVICE_COVERED_PLUGINS,
+                                    build_volume_overlay, volume_mask)
+        enabled_hosts = {p.name() for p in fwk.host_filter_plugins}
+        vol_mask_dev = None
+        if (DEVICE_COVERED_PLUGINS & enabled_hosts
+                and any(qp.pod.spec.volumes for qp in live)):
+            overlay = build_volume_overlay(
+                self.store, node_infos, [qp.pod for qp in live],
+                builder.table, enabled_hosts)
+            if overlay is not None:
+                vol_mask_dev = volume_mask(cluster, overlay)
         host_ok = np.ones((B, N), bool)
         any_host = False
         for i, qp in enumerate(live):
             if not host_relevant[qp.pod.uid]:
                 continue
+            if (vol_mask_dev is not None
+                    and not fwk.has_relevant_host_filters(
+                        qp.pod, exclude=DEVICE_COVERED_PLUGINS)):
+                continue   # every relevant host filter is device-covered
             any_host = True
             state = states[qp.pod.uid]
             for j, ni in enumerate(node_infos):
@@ -593,10 +623,12 @@ class Scheduler:
         host_ok_dev = None
         if any_host:
             host_ok_dev = self._jax.numpy.asarray(host_ok)
+        if vol_mask_dev is not None:
+            host_ok_dev = (vol_mask_dev if host_ok_dev is None
+                           else host_ok_dev & vol_mask_dev)
         if nom_mask is not None:
             host_ok_dev = (nom_mask if host_ok_dev is None
                            else host_ok_dev & nom_mask)
-            any_host = True
         cfg = programs.ProgramConfig(
             filters=fwk.tensor_filters, scores=fwk.tensor_scores,
             hostname_topokey=max(builder.table.topokey.get(api.LABEL_HOSTNAME), 0),

@@ -1,0 +1,196 @@
+"""Device-side volume family (state/volumes.py): the jitted [B, N] mask
+must agree EXACTLY with the host plugin loop (plugins/volumes.py) — the
+mask replaces the per-(pod, node) Python filter calls on the serving path,
+so any divergence is a placement bug.  Randomized differential test over
+worlds with bound/unbound PVCs, PV node affinity, zone labels, CSI and
+in-tree attach limits."""
+import random
+
+import numpy as np
+
+from kubetpu.api import types as api
+from kubetpu.client.store import ClusterStore
+from kubetpu.framework.interface import CycleState
+from kubetpu.framework.types import NodeInfo, PodInfo
+from kubetpu.plugins import volumes as vplug
+from kubetpu.state.tensors import SnapshotBuilder
+from kubetpu.state.volumes import build_volume_overlay, volume_mask
+from tests.test_tensors import mknode, mkpod
+
+PLUGIN_CLASSES = (vplug.VolumeBinding, vplug.VolumeZone,
+                  vplug.NodeVolumeLimits, vplug.EBSLimits,
+                  vplug.GCEPDLimits, vplug.AzureDiskLimits,
+                  vplug.CinderLimits, vplug.VolumeRestrictions)
+ENABLED = {c.NAME for c in PLUGIN_CLASSES}
+
+
+def build_world(seed):
+    rng = random.Random(seed)
+    store = ClusterStore()
+    zones = ["us-a", "us-b", "us-c"]
+    nodes = []
+    for i in range(6):
+        labels = {api.LABEL_HOSTNAME: f"n{i}"}
+        if rng.random() < 0.7:
+            labels[api.LABEL_ZONE] = rng.choice(zones)
+        if rng.random() < 0.3:
+            labels[vplug.LABEL_INSTANCE_TYPE] = rng.choice(
+                ["m5.large", "t2.small"])
+        n = mknode(name=f"n{i}", labels=labels)
+        if rng.random() < 0.5:
+            n.status.allocatable["attachable-volumes-aws-ebs"] = str(
+                rng.randint(1, 3))
+        store.add(n)
+        nodes.append(n)
+        if rng.random() < 0.5:
+            store.add(api.CSINode(
+                metadata=api.ObjectMeta(name=n.name),
+                driver_allocatable={"csi.example.com": rng.randint(1, 2)}))
+
+    store.add(api.StorageClass(
+        metadata=api.ObjectMeta(name="fast"),
+        provisioner="kubernetes.io/aws-ebs"))
+    store.add(api.StorageClass(
+        metadata=api.ObjectMeta(name="wait"),
+        volume_binding_mode="WaitForFirstConsumer"))
+
+    pv_names = []
+    for i in range(10):
+        labels = {}
+        if rng.random() < 0.4:
+            labels[api.LABEL_ZONE] = rng.choice(
+                zones + ["us-a__us-b"])
+        aff = None
+        if rng.random() < 0.4:
+            aff = api.NodeSelector(node_selector_terms=[
+                api.NodeSelectorTerm(match_expressions=[
+                    api.NodeSelectorRequirement(
+                        key=api.LABEL_ZONE, operator="In",
+                        values=[rng.choice(zones)])])])
+        pv = api.PersistentVolume(
+            metadata=api.ObjectMeta(name=f"pv{i}", labels=labels),
+            node_affinity=aff,
+            storage_class_name=rng.choice(["fast", "", "wait"]),
+            aws_elastic_block_store=(f"ebs-{i}" if rng.random() < 0.4
+                                     else None),
+            csi_driver=("csi.example.com" if rng.random() < 0.3 else None),
+            csi_volume_handle=f"h{i}")
+        store.add(pv)
+        pv_names.append(pv.metadata.name)
+
+    def make_vol_pod(name, bound_frac=0.7):
+        p = mkpod(name=name)
+        vols = []
+        for j in range(rng.randint(1, 2)):
+            kind = rng.random()
+            if kind < 0.15:
+                vols.append(api.Volume(name=f"e{j}",
+                                       aws_elastic_block_store=f"ebs-{name}-{j}"
+                                       if rng.random() < 0.5 else "ebs-shared"))
+            elif kind < 0.3:
+                # gce conflicts are read-only-exempt: exercise both sides
+                vols.append(api.Volume(name=f"g{j}",
+                                       gce_persistent_disk="pd-shared",
+                                       read_only=rng.random() < 0.5))
+            else:
+                claim = f"{name}-c{j}"
+                if rng.random() < bound_frac:
+                    pvc = api.PersistentVolumeClaim(
+                        metadata=api.ObjectMeta(name=claim),
+                        volume_name=rng.choice(pv_names))
+                else:
+                    pvc = api.PersistentVolumeClaim(
+                        metadata=api.ObjectMeta(name=claim),
+                        storage_class_name=rng.choice(["fast", "wait", ""]))
+                store.add(pvc)
+                vols.append(api.Volume(name=f"v{j}",
+                                       persistent_volume_claim=claim))
+        p.spec.volumes = vols
+        return p
+
+    infos = []
+    for n in nodes:
+        ni = NodeInfo(n)
+        for k in range(rng.randint(0, 2)):
+            ep = make_vol_pod(f"ex-{n.name}-{k}")
+            ep.spec.node_name = n.name
+            ni.add_pod(ep)
+        infos.append(ni)
+
+    pending = [make_vol_pod(f"pend-{i}") for i in range(8)]
+    # some volume-less pods exercise the all-true rows
+    pending.append(mkpod(name="plain"))
+    return store, infos, pending
+
+
+def host_verdicts(store, infos, pending):
+    plugins = [cls(store) for cls in PLUGIN_CLASSES]
+    out = np.ones((len(pending), len(infos)), bool)
+    for i, pod in enumerate(pending):
+        for p in plugins:
+            if not p.relevant(pod):
+                continue
+            for j, ni in enumerate(infos):
+                st = p.filter(CycleState(), pod, ni)
+                if not st.is_success():
+                    out[i, j] = False
+    return out
+
+
+def test_volume_mask_matches_host_plugins():
+    for seed in range(6):
+        store, infos, pending = build_world(seed)
+        sb = SnapshotBuilder()
+        sb.intern_pending([PodInfo(p) for p in pending])
+        cluster = sb.build(infos).to_device()
+        overlay = build_volume_overlay(store, infos, pending, sb.table,
+                                       ENABLED)
+        assert overlay is not None
+        got = np.asarray(volume_mask(cluster, overlay))
+        want = host_verdicts(store, infos, pending)
+        B, N = want.shape
+        mismatch = np.argwhere(got[:B, :N] != want)
+        assert mismatch.size == 0, (
+            f"seed {seed}: mask disagrees at (pod, node) {mismatch[:5]}; "
+            f"pods {[pending[i].metadata.name for i, _ in mismatch[:5]]}")
+
+
+def test_volume_mask_none_without_volumes():
+    store = ClusterStore()
+    infos = [NodeInfo(mknode(name="n0"))]
+    pending = [mkpod(name="p0")]
+    sb = SnapshotBuilder()
+    sb.intern_pending([PodInfo(p) for p in pending])
+    assert build_volume_overlay(store, infos, pending, sb.table,
+                                ENABLED) is None
+
+
+def test_volume_mask_multi_pv_zone_intersection():
+    """Two bound PVs in different zones: the node must satisfy EACH PV's
+    zone set (intersection), not the union — the host plugin fails every
+    node and so must the mask."""
+    store = ClusterStore()
+    nodes = []
+    for i, z in enumerate(["us-a", "us-b"]):
+        n = mknode(name=f"n{i}", labels={api.LABEL_ZONE: z})
+        store.add(n)
+        nodes.append(n)
+    for name, z in (("pva", "us-a"), ("pvb", "us-b")):
+        store.add(api.PersistentVolume(
+            metadata=api.ObjectMeta(name=name,
+                                    labels={api.LABEL_ZONE: z})))
+        store.add(api.PersistentVolumeClaim(
+            metadata=api.ObjectMeta(name="c-" + name), volume_name=name))
+    pod = mkpod(name="two-zones")
+    pod.spec.volumes = [
+        api.Volume(name="a", persistent_volume_claim="c-pva"),
+        api.Volume(name="b", persistent_volume_claim="c-pvb")]
+    infos = [NodeInfo(n) for n in nodes]
+    sb = SnapshotBuilder()
+    sb.intern_pending([PodInfo(pod)])
+    cluster = sb.build(infos).to_device()
+    overlay = build_volume_overlay(store, infos, [pod], sb.table, ENABLED)
+    got = np.asarray(volume_mask(cluster, overlay))[0, :2]
+    want = host_verdicts(store, infos, [pod])[0]
+    np.testing.assert_array_equal(got, want)
+    assert not want.any()
