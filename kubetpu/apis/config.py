@@ -119,6 +119,14 @@ class KubeSchedulerConfiguration:
     # XLA cache this is a cache load; cold, it moves the first-cycle
     # compile out of the serving path (VERDICT r3 #7)
     prewarm: bool = True
+    # prewarm_ladder > 0 additionally AOT-compiles the pod-axis pow2
+    # bucket ladder a growing chained drain will traverse, by dry-running
+    # that many chained cycles in a BACKGROUND thread after startup (gang
+    # mode; see Scheduler._prewarm_ladder).  Without it, each new bucket
+    # a drain grows into stalls serving for its compile.  Measured warm
+    # restart (bench.py warm_restart_case, 1024-pod wave x 1000 nodes):
+    # first cycle 0.36 s.
+    prewarm_ladder: int = 2
     # Double-buffered drain (gang + chain_cycles only): schedule_pending
     # dispatches cycle k against the previous cycle's speculative on-device
     # chained cluster BEFORE committing cycle k-1, so cycle k's device

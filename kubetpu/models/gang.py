@@ -277,6 +277,11 @@ def schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
     but compiled program expected N+1' (argument-pruning bookkeeping
     crossing cache entries); routing every call through one Python frame
     avoids the C++ fastpath state that triggers it."""
+    # the auction never samples nodes (it needs the global view), so
+    # percentage_of_nodes_to_score must not split the program cache —
+    # normalize it out of the static key
+    if cfg.percentage_of_nodes_to_score != 100:
+        cfg = cfg._replace(percentage_of_nodes_to_score=100)
     return _schedule_gang(cluster, batch, cfg, rng, host_ok=host_ok,
                           max_rounds=max_rounds,
                           intra_batch_topology=intra_batch_topology,
