@@ -310,6 +310,26 @@ def pv_heavy_case(n_nodes=1000, n_pods=2048):
     return stats
 
 
+def preemption_case(n_nodes=500, fillers=2000, high_prio=256):
+    """Preemption under load (VERDICT r4 #9): the cluster is packed with
+    low-priority fillers (4 x 900m per 4-cpu node), then high-priority
+    600m pods arrive — every placement must select victims through the
+    PostFilter preemption pipeline (eligibility, batched what-if,
+    PDB-ordered reprieve, pickOne)."""
+    from kubetpu.harness.perf import Workload, run_workload
+    t0 = time.time()
+    items = run_workload(Workload(
+        name="PreemptionBench", num_nodes=n_nodes, num_init_pods=fillers,
+        num_pods_to_schedule=high_prio, preemption=True, batch_size=1024,
+        timeout_s=420))
+    dt = time.time() - t0
+    thr = next(it.data for it in items
+               if it.labels.get("Metric") == "SchedulingThroughput")
+    return {"nodes": n_nodes, "fillers": fillers, "high_prio": high_prio,
+            "e2e_s": round(dt, 1),
+            "preempting_pods_per_sec": thr}
+
+
 def warm_restart_case(n_nodes=1000, existing_per_node=2, wave=1024,
                       ladder=2):
     """Warm-restart SLO (VERDICT r4 #5): a fresh Scheduler in THIS process
@@ -498,6 +518,12 @@ def main() -> None:
             detail["pv_heavy"] = pv_heavy_case()
         except Exception as e:  # pragma: no cover - depends on device state
             detail["pv_heavy"] = {"error": repr(e)}
+
+    if os.environ.get("BENCH_PREEMPT", "1") == "1" and mesh_shape is None:
+        try:
+            detail["preemption"] = preemption_case()
+        except Exception as e:  # pragma: no cover - depends on device state
+            detail["preemption"] = {"error": repr(e)}
 
     if full:
         northstar = {}

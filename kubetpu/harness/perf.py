@@ -43,10 +43,26 @@ class Workload:
     preferred_pod_anti_affinity: bool = False
     topology_spread: bool = False            # hard, zone
     preferred_topology_spread: bool = False  # soft, zone
-    pvs: bool = False                        # one pre-bound PV/PVC per pod
+    pvs: bool = False                        # one pre-bound in-tree PV/PVC
+    secrets: bool = False                    # secret volume (no constraint)
+    csi_pvs: bool = False                    # CSI PV/PVC + CSINode limits
+    migrated_pvs: bool = False               # in-tree PV under CSINode limits
+                                             # (CSI-migration translation is a
+                                             # documented deviation; counts
+                                             # land on the in-tree filter)
+    node_affinity: bool = False              # required node affinity on zone
+    preemption: bool = False                 # init: low-priority fillers;
+                                             # measured: high-priority pods
+    unschedulable: bool = False              # init: node-sized cpu hogs
+    skip_wait_init: bool = False             # don't wait for init pods
+                                             # (reference: Unschedulable's
+                                             # skipWaitUntilInitPodsScheduled)
     group_labels: int = 10
     zones: int = 8
     batch_size: int = 256
+    timeout_s: float = 300.0   # per-phase scheduling deadline
+    mode: str = "gang"         # serving default; "sequential" = exact
+                               # serial-replay oracle
     # mixed mode: measured pods cycle through all enabled features
     mixed: bool = False
 
@@ -63,7 +79,27 @@ class DataItem:
 
 
 def _make_pod(w: Workload, i: int, prefix: str, store: ClusterStore) -> api.Pod:
-    p = hollow.make_pod(f"{prefix}-{i}", cpu_milli=100, mem=250 << 20,
+    # special init/measured template splits (reference: Preemption and
+    # Unschedulable templates use different init vs measured pod YAMLs)
+    if w.preemption and prefix == "init":
+        # low-priority fillers, four per 4-cpu node (reference:
+        # pod-low-priority.yaml; 2000 init / 500 nodes)
+        return hollow.make_pod(f"{prefix}-{i}", cpu_milli=900,
+                               mem=250 << 20, priority=-10,
+                               labels={"group": prefix})
+    if w.unschedulable and prefix == "init":
+        # cpu ask EXCEEDS a whole node (reference: pod-large-cpu.yaml asks
+        # more than node capacity) — these pods must stay pending and
+        # churn the unschedulable queue while measured pods flow
+        return hollow.make_pod(f"{prefix}-{i}", cpu_milli=4900,
+                               mem=250 << 20, labels={"group": prefix})
+    # preemption's measured pods ask for more cpu than the fillers leave
+    # free, so every placement must evict a victim (PostFilter path)
+    preempting = w.preemption and prefix == "measured"
+    p = hollow.make_pod(f"{prefix}-{i}",
+                        cpu_milli=600 if preempting else 100,
+                        mem=250 << 20,
+                        priority=100 if preempting else 0,
                         labels={"app": f"app-{i % w.group_labels}",
                                 "group": prefix})
     features = []
@@ -81,8 +117,19 @@ def _make_pod(w: Workload, i: int, prefix: str, store: ClusterStore) -> api.Pod:
         features.append("pspread")
     if w.pvs:
         features.append("pv")
-    if w.mixed and features:
-        features = [features[i % len(features)]]
+    if w.secrets:
+        features.append("secret")
+    if w.csi_pvs:
+        features.append("csipv")
+    if w.migrated_pvs:
+        features.append("migpv")
+    if w.node_affinity:
+        features.append("nodeaff")
+    if w.mixed:
+        # reference MixedSchedulingBasePod: INIT pods cycle through the
+        # feature templates; MEASURED pods are plain default pods
+        features = ([features[i % len(features)]]
+                    if prefix == "init" and features else [])
     for f in features:
         if f == "anti":
             hollow.with_anti_affinity(p, api.LABEL_HOSTNAME,
@@ -115,17 +162,44 @@ def _make_pod(w: Workload, i: int, prefix: str, store: ClusterStore) -> api.Pod:
             hollow.with_spread(p, api.LABEL_ZONE, max_skew=1,
                                when="ScheduleAnyway",
                                match={"group": prefix})
-        elif f == "pv":
+        elif f in ("pv", "csipv", "migpv"):
             pv_name = f"pv-{prefix}-{i}"
             pvc_name = f"pvc-{prefix}-{i}"
-            store.add(api.PersistentVolume(
+            pv = api.PersistentVolume(
                 metadata=api.ObjectMeta(name=pv_name),
-                storage_class_name="perf"))
+                storage_class_name="perf")
+            if f == "csipv":
+                # reference: pv-csi.yaml + csiNodeAllocatable 39/node
+                pv.csi_driver = "ebs.csi.aws.com"
+                pv.csi_volume_handle = pv_name
+            else:
+                # in-tree EBS source; "migpv" keeps the in-tree source but
+                # the cluster also carries CSINode limits (the migration
+                # TRANSLATION itself is a documented deviation)
+                pv.aws_elastic_block_store = pv_name
+            store.add(pv)
             store.add(api.PersistentVolumeClaim(
                 metadata=api.ObjectMeta(name=pvc_name),
                 storage_class_name="perf", volume_name=pv_name))
             p.spec.volumes.append(api.Volume(
                 name="v", persistent_volume_claim=pvc_name))
+        elif f == "secret":
+            # a secret volume constrains nothing at scheduling time — the
+            # workload measures the volume-bearing fast path (reference:
+            # pod-with-secret-volume.yaml)
+            p.spec.volumes.append(api.Volume(name="secret"))
+        elif f == "nodeaff":
+            # required node affinity on the zone label (reference:
+            # pod-with-node-affinity.yaml In [zone-0 zone-1])
+            aff = p.spec.affinity or api.Affinity()
+            aff.node_affinity = api.NodeAffinity(
+                required_during_scheduling_ignored_during_execution=(
+                    api.NodeSelector(node_selector_terms=[
+                        api.NodeSelectorTerm(match_expressions=[
+                            api.NodeSelectorRequirement(
+                                key=api.LABEL_ZONE, operator="In",
+                                values=["zone-0", "zone-1"])])])))
+            p.spec.affinity = aff
     return p
 
 
@@ -177,11 +251,17 @@ def run_workload(w: Workload, verbose: bool = False) -> List[DataItem]:
     store = ClusterStore()
     for n in hollow.make_nodes(w.num_nodes, zones=w.zones):
         store.add(n)
-    if w.pvs:
+        if w.csi_pvs or w.migrated_pvs:
+            # reference: nodeAllocatableStrategy csiNodeAllocatable 39
+            store.add(api.CSINode(
+                metadata=api.ObjectMeta(name=n.name),
+                driver_allocatable={"ebs.csi.aws.com": 39}))
+    if w.pvs or w.csi_pvs or w.migrated_pvs:
         store.add(api.StorageClass(metadata=api.ObjectMeta(name="perf")))
     metrics = SchedulerMetrics()
     cfg = KubeSchedulerConfiguration(profiles=[KubeSchedulerProfile()],
-                                     batch_size=w.batch_size)
+                                     batch_size=w.batch_size, mode=w.mode,
+                                     chain_cycles=True)
     sched = Scheduler(store, config=cfg, metrics=metrics, async_binding=True)
     thread = sched.run()
     try:
@@ -189,16 +269,24 @@ def run_workload(w: Workload, verbose: bool = False) -> List[DataItem]:
         if w.num_init_pods:
             for i in range(w.num_init_pods):
                 store.add(_make_pod(w, i, "init", store))
-            coll = ThroughputCollector(store, "init")
-            if not coll.run_until(w.num_init_pods):
-                raise RuntimeError(
-                    f"{w.name}: init pods did not schedule "
-                    f"({coll.bound_count()}/{w.num_init_pods})")
+            if w.skip_wait_init:
+                # reference: skipWaitUntilInitPodsScheduled — some init
+                # pods may be unschedulable by design; give the queue one
+                # flush interval to absorb them
+                time.sleep(2.0)
+            else:
+                coll = ThroughputCollector(store, "init")
+                if not coll.run_until(w.num_init_pods,
+                                      timeout=w.timeout_s):
+                    raise RuntimeError(
+                        f"{w.name}: init pods did not schedule "
+                        f"({coll.bound_count()}/{w.num_init_pods})")
         # phase 2: measured pods
         for i in range(w.num_pods_to_schedule):
             store.add(_make_pod(w, i, "measured", store))
         coll = ThroughputCollector(store, "measured")
-        done = coll.run_until(w.num_pods_to_schedule)
+        done = coll.run_until(w.num_pods_to_schedule,
+                              timeout=w.timeout_s)
         sched.wait_for_inflight_binds()
         scheduled = coll.bound_count()
         if verbose:
@@ -253,10 +341,23 @@ DEFAULT_WORKLOADS: List[Workload] = [
              preferred_topology_spread=True),
     Workload(name="SchedulingInTreePVs", num_nodes=100, num_init_pods=50,
              num_pods_to_schedule=100, pvs=True),
+    Workload(name="SchedulingSecrets", num_nodes=100, num_init_pods=100,
+             num_pods_to_schedule=300, secrets=True),
+    Workload(name="SchedulingCSIPVs", num_nodes=100, num_init_pods=50,
+             num_pods_to_schedule=100, csi_pvs=True),
+    Workload(name="SchedulingMigratedInTreePVs", num_nodes=100,
+             num_init_pods=50, num_pods_to_schedule=100, migrated_pvs=True),
+    Workload(name="SchedulingNodeAffinity", num_nodes=100, num_init_pods=100,
+             num_pods_to_schedule=300, node_affinity=True),
     Workload(name="MixedSchedulingBasePod", num_nodes=100, num_init_pods=200,
              num_pods_to_schedule=300, pod_anti_affinity=True,
              pod_affinity=True, preferred_pod_affinity=True,
              topology_spread=True, mixed=True),
+    Workload(name="Preemption", num_nodes=100, num_init_pods=400,
+             num_pods_to_schedule=100, preemption=True),
+    Workload(name="Unschedulable", num_nodes=100, num_init_pods=40,
+             num_pods_to_schedule=200, unschedulable=True,
+             skip_wait_init=True),
 ]
 
 

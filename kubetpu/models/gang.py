@@ -247,7 +247,8 @@ def materialize_assigned(cluster, batch, chosen, requested, nz, ports_used,
 
 
 def run_auction(cluster, batch, cfg: ProgramConfig, rng,
-                host_ok=None, intra_batch_topology: bool = True) -> GangResult:
+                host_ok=None, intra_batch_topology: bool = True,
+                score_bias=None) -> GangResult:
     """The serving-loop gang entry: ONE device dispatch, ONE small readback.
 
     Round 3 ran a two-phase host-orchestrated residual auction here (full
@@ -261,7 +262,8 @@ def run_auction(cluster, batch, cfg: ProgramConfig, rng,
     monolithic while_loop (all rounds on device, zero intermediate syncs)
     is strictly faster at every measured shape, so it IS the auction."""
     return schedule_gang(cluster, batch, cfg, rng, host_ok=host_ok,
-                         intra_batch_topology=intra_batch_topology)
+                         intra_batch_topology=intra_batch_topology,
+                         score_bias=score_bias)
 
 
 def schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
@@ -269,7 +271,8 @@ def schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
                   max_rounds: Optional[int] = None,
                   intra_batch_topology: bool = True,
                   tie_index: Optional[jnp.ndarray] = None,
-                  residual_window: int = 512) -> GangResult:
+                  residual_window: int = 512,
+                  score_bias: Optional[jnp.ndarray] = None) -> GangResult:
     """Python entry for the jitted auction.  The indirection is a REQUIRED
     workaround for this runtime's jit dispatch: calling the jit object
     directly from multiple call sites with different static-arg
@@ -286,7 +289,8 @@ def schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
                           max_rounds=max_rounds,
                           intra_batch_topology=intra_batch_topology,
                           tie_index=tie_index,
-                          residual_window=residual_window)
+                          residual_window=residual_window,
+                          score_bias=score_bias)
 
 
 @functools.partial(jax.jit,
@@ -298,7 +302,8 @@ def _schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
                    max_rounds: Optional[int] = None,
                    intra_batch_topology: bool = True,
                    tie_index: Optional[jnp.ndarray] = None,
-                   residual_window: int = 512) -> GangResult:
+                   residual_window: int = 512,
+                   score_bias: Optional[jnp.ndarray] = None) -> GangResult:
     from .batch import densify_for
     batch = densify_for(cluster, batch)
     B = batch.req.shape[0]
@@ -412,7 +417,7 @@ def _schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
         sb = dict(rows=jnp.arange(B, dtype=jnp.int32), valid=batch.valid,
                   batch=batch, static_ok=static_ok, ports_ok0=ports_ok0,
                   affinity_ok=affinity_ok, tie_keys=tie_keys,
-                  score_pre=score_pre)
+                  score_pre=score_pre, score_bias=score_bias)
         if intra:
             sb["sph_match"] = sph_match
             sb["ipa_pre"] = ipa_pre
@@ -455,7 +460,9 @@ def _schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
         sb = dict(rows=rows, valid=sub_batch.valid, batch=sub_batch,
                   static_ok=g(static_ok), ports_ok0=g(ports_ok0),
                   affinity_ok=g(affinity_ok), tie_keys=g(tie_keys),
-                  score_pre={k: g_pre(v) for k, v in score_pre.items()})
+                  score_pre={k: g_pre(v) for k, v in score_pre.items()},
+                  score_bias=None if score_bias is None
+                  else g(score_bias))
         if intra:
             sb["sph_match"] = g(sph_match) if use_sph else None
             sb["ipa_pre"] = g_pre(ipa_pre) if use_ipa else None
@@ -590,6 +597,10 @@ def _schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
         # earlier rounds' pods (the batched analog of assume-before-next-pod)
         scores, _ = run_scores(cl, sbatch, cfg, feas, sb["affinity_ok"],
                                pre=sb["score_pre"])
+        if sb.get("score_bias") is not None:
+            # weighted host Score/NormalizeScore plugin totals, computed by
+            # the framework runner pre-dispatch (framework.go:579-656)
+            scores = scores + sb["score_bias"]
 
         masked = jnp.where(feas, scores, _NEG)
         best = jnp.max(masked, axis=1)
@@ -716,7 +727,12 @@ def _schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
 
         out = jax.lax.while_loop(condw, bodyw, out)
     unresolvable = out["unres"]
-    all_unres = jnp.all(unresolvable | out["feas0"] | ~base, axis=1)
+    # the preemption gate must see HOST-filter failures as resolvable
+    # (nodesWherePreemptionMightHelp counts them;
+    # preemption._nodes_where_preemption_might_help re-checks them), so
+    # host_ok is deliberately NOT part of this node-exclusion mask
+    base_nodes = cluster.node_valid[None, :] & batch.valid[:, None]
+    all_unres = jnp.all(unresolvable | out["feas0"] | ~base_nodes, axis=1)
     n_feas = jnp.sum(out["feas0"].astype(jnp.int32), axis=1)
     packed = jnp.concatenate([out["assigned"], n_feas,
                               all_unres.astype(jnp.int32),

@@ -88,20 +88,24 @@ def _batch_term_matches(terms, batch, B):
 
 def schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
                         hard_pod_affinity_weight: float = 1.0,
-                        host_ok=None, start_index=0) -> SeqResult:
+                        host_ok=None, start_index=0,
+                        score_bias=None) -> SeqResult:
     """Python entry for the jitted scan — same required dispatch-bug
     workaround as gang.schedule_gang (one Python frame between callers and
-    the jit object; see that docstring)."""
+    the jit object; see that docstring).  score_bias: optional [B, N] f32
+    of weighted host-plugin scores (framework runner's Score/NormalizeScore
+    extension point) added to the device total before selectHost."""
     return _schedule_sequential(
         cluster, batch, cfg, rng,
         hard_pod_affinity_weight=hard_pod_affinity_weight,
-        host_ok=host_ok, start_index=start_index)
+        host_ok=host_ok, start_index=start_index, score_bias=score_bias)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=())
 def _schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
                          hard_pod_affinity_weight: float = 1.0,
-                         host_ok=None, start_index=0) -> SeqResult:
+                         host_ok=None, start_index=0,
+                         score_bias=None) -> SeqResult:
     from .batch import densify_for
     batch = densify_for(cluster, batch)
     B = batch.req.shape[0]
@@ -536,6 +540,8 @@ def _schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
             total += jnp.where(feas, s, 0.0) * score_w["DefaultPodTopologySpread"]
 
         # ---- select
+        if score_bias is not None:
+            total = total + score_bias[i]
         masked = jnp.where(feas, total, neg)
         best = jnp.max(masked)
         ties = (masked == best) & feas
@@ -544,7 +550,10 @@ def _schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
         has = jnp.any(feas)
         chosen = jnp.where(has, choice.astype(jnp.int32), -1)
         n_feas = jnp.sum(feas.astype(jnp.int32))
-        all_unres = jnp.all(unres | feas | ~base[i])
+        # host-filter failures stay RESOLVABLE for the preemption gate
+        # (host_ok is folded into base but not into this exclusion mask)
+        base_nodes_i = cluster.node_valid & batch.valid[i]
+        all_unres = jnp.all(unres | feas | ~base_nodes_i)
         win_score = jnp.where(has, best, 0.0)
 
         # ---- apply placement to carries (no-op when unschedulable)

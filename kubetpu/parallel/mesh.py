@@ -163,7 +163,8 @@ def _shard_host_ok(host_ok, mesh: Mesh):
 def sharded_schedule_gang(cluster, batch, cfg: programs.ProgramConfig, rng,
                           mesh: Mesh, shard_existing_pods: bool = True,
                           max_rounds: Optional[int] = None,
-                          host_ok=None, intra_batch_topology: bool = True):
+                          host_ok=None, intra_batch_topology: bool = True,
+                          score_bias=None):
     """Gang auction over the mesh.  The [B, N] filter/score work shards over
     both axes; the admission sort + segmented prefix-sums are [B]-sized (a
     few MB even at 100k pods), which XLA gathers as needed — the per-round
@@ -175,14 +176,17 @@ def sharded_schedule_gang(cluster, batch, cfg: programs.ProgramConfig, rng,
         return gang.schedule_gang(cluster, batch, cfg, rng,
                                   host_ok=_shard_host_ok(host_ok, mesh),
                                   max_rounds=max_rounds,
-                                  intra_batch_topology=intra_batch_topology)
+                                  intra_batch_topology=intra_batch_topology,
+                                  score_bias=_shard_host_ok(score_bias,
+                                                            mesh))
 
 
 def sharded_schedule_sequential(cluster, batch, cfg: programs.ProgramConfig,
                                 rng, mesh: Mesh,
                                 shard_existing_pods: bool = True,
                                 hard_pod_affinity_weight: float = 1.0,
-                                host_ok=None, start_index=0):
+                                host_ok=None, start_index=0,
+                                score_bias=None):
     """Sequential-replay scan over the mesh: the scan axis (pods, in order)
     is serial by construction; each step's per-node work shards over
     "nodes" and the precomputed O(B×P×N) matmuls shard over both axes."""
@@ -194,4 +198,5 @@ def sharded_schedule_sequential(cluster, batch, cfg: programs.ProgramConfig,
             cluster, batch, cfg, rng,
             hard_pod_affinity_weight=hard_pod_affinity_weight,
             host_ok=_shard_host_ok(host_ok, mesh),
-            start_index=start_index)
+            start_index=start_index,
+            score_bias=_shard_host_ok(score_bias, mesh))

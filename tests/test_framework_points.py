@@ -1,0 +1,238 @@
+"""Injected-plugin integration matrix: one test per framework extension
+point, driving the REAL serving path (store -> queue -> device program ->
+commit) and asserting invocation, ordering, and failure propagation —
+the analog of the reference's per-point harness
+(test/integration/scheduler/framework_test.go:509-1632: PreFilter, Filter,
+PostFilter, Score, NormalizeScore, Reserve, PreBind, Bind, PostBind,
+Unreserve; Permit lives in tests/test_permit.py)."""
+from typing import List, Optional
+
+from kubetpu.api import types as api
+from kubetpu.apis.config import (KubeSchedulerConfiguration,
+                                 KubeSchedulerProfile, Plugin, Plugins,
+                                 PluginSet)
+from kubetpu.client.store import ClusterStore
+from kubetpu.framework import interface as fw
+from kubetpu.framework.interface import CycleState, Status
+from kubetpu.harness import hollow
+from kubetpu.plugins.intree import new_in_tree_registry
+from kubetpu.scheduler import Scheduler
+
+CALLS: List[tuple] = []   # (point, pod, extra)
+
+
+class RecordingPlugin(fw.PreFilterPlugin, fw.FilterPlugin,
+                      fw.PostFilterPlugin, fw.ScorePlugin,
+                      fw.ReservePlugin, fw.UnreservePlugin,
+                      fw.PreBindPlugin, fw.BindPlugin, fw.PostBindPlugin):
+    """One plugin registered at every point, with per-point failure
+    injection (reference: framework_test.go's *Plugin test doubles)."""
+
+    def __init__(self, name="TestPoints", fail_at: Optional[str] = None,
+                 score_map=None):
+        self._name = name
+        self.fail_at = fail_at
+        self.score_map = score_map or {}
+
+    def name(self):
+        return self._name
+
+    def _rec(self, point, pod, extra=None):
+        CALLS.append((point, pod.metadata.name, extra))
+
+    def pre_filter(self, state, pod):
+        self._rec("PreFilter", pod)
+        if self.fail_at == "PreFilter":
+            return Status.unschedulable("injected prefilter failure")
+        return Status.success()
+
+    def filter(self, state, pod, node_info):
+        self._rec("Filter", pod, node_info.node_name)
+        if self.fail_at == "Filter":
+            return Status.unschedulable("injected filter failure")
+        if self.fail_at == f"Filter:{node_info.node_name}":
+            return Status.unschedulable("injected per-node failure")
+        return Status.success()
+
+    def post_filter(self, state, pod, filtered_node_status_map=None):
+        self._rec("PostFilter", pod)
+        return None, Status.unschedulable("no preemption")
+
+    def score(self, state, pod, node_name):
+        self._rec("Score", pod, node_name)
+        return self.score_map.get(node_name, 0), Status.success()
+
+    def score_extensions(self):
+        outer = self
+
+        class Ext:
+            def normalize_score(self, state, pod, scores):
+                outer._rec("NormalizeScore", pod)
+                top = max(s for _, s in scores) or 1
+                return ([(n, s * fw.MAX_NODE_SCORE // top)
+                         for n, s in scores], Status.success())
+        return Ext()
+
+    def reserve(self, state, pod, node_name):
+        self._rec("Reserve", pod, node_name)
+        if self.fail_at == "Reserve":
+            return Status.error("injected reserve failure")
+        return Status.success()
+
+    def unreserve(self, state, pod, node_name):
+        self._rec("Unreserve", pod, node_name)
+
+    def pre_bind(self, state, pod, node_name):
+        self._rec("PreBind", pod, node_name)
+        if self.fail_at == "PreBind":
+            return Status.error("injected prebind failure")
+        return Status.success()
+
+    def bind(self, state, pod, node_name):
+        self._rec("Bind", pod, node_name)
+        if self.fail_at == "Bind":
+            return Status.error("injected bind failure")
+        # skip: fall through to the next bind plugin (DefaultBinder)
+        return Status(fw.Code.SKIP)
+
+    def post_bind(self, state, pod, node_name):
+        self._rec("PostBind", pod, node_name)
+
+
+POINTS = ("pre_filter", "filter", "post_filter", "score", "reserve",
+          "pre_bind", "bind", "post_bind", "unreserve")
+
+
+def build_sched(n_nodes=2, fail_at=None, score_map=None, name="TestPoints"):
+    CALLS.clear()
+    store = ClusterStore()
+    for n in hollow.make_nodes(n_nodes):
+        store.add(n)
+    registry = dict(new_in_tree_registry())
+    registry[name] = lambda args, handle: RecordingPlugin(
+        name, fail_at=fail_at, score_map=score_map)
+    sets = {p: PluginSet(enabled=[Plugin(name)]) for p in POINTS}
+    # the injected bind plugin runs FIRST, DefaultBinder after it (the
+    # default set would put DefaultBinder first and shadow it)
+    sets["bind"] = PluginSet(enabled=[Plugin(name), Plugin("DefaultBinder")],
+                             disabled=[Plugin("*")])
+    cfg = KubeSchedulerConfiguration(
+        profiles=[KubeSchedulerProfile(plugins=Plugins(**sets))],
+        batch_size=8, mode="gang", prewarm=False)
+    sched = Scheduler(store, config=cfg, registry=registry,
+                      async_binding=False)
+    return store, sched
+
+
+def points_called(pod):
+    return [p for p, name, _ in CALLS if name == pod]
+
+
+def test_success_path_invokes_points_in_order():
+    store, sched = build_sched()
+    store.add(hollow.make_pod("pod-a"))
+    out = sched.schedule_pending(timeout=0.2)
+    assert len(out) == 1 and out[0].node
+    seq = points_called("pod-a")
+    # Filter runs per node pre-dispatch; Score/Normalize once pre-dispatch;
+    # the commit pipeline is Filter(re-check) -> Reserve -> PreBind ->
+    # Bind -> PostBind, strictly ordered (framework_test.go:509 ordering)
+    for a, b in [("PreFilter", "Filter"), ("Filter", "Score"),
+                 ("Score", "NormalizeScore"), ("NormalizeScore", "Reserve"),
+                 ("Reserve", "PreBind"), ("PreBind", "Bind"),
+                 ("Bind", "PostBind")]:
+        assert seq.index(a) < seq.index(b), seq
+    assert "Unreserve" not in seq
+    assert "PostFilter" not in seq
+    sched.close()
+
+
+def test_score_steers_placement():
+    """An injected Score plugin (weight 1, normalized) must move the pod:
+    score node-1 high, node-0 low."""
+    store, sched = build_sched(score_map={"node-0": 1, "node-1": 100})
+    store.add(hollow.make_pod("pod-a"))
+    out = sched.schedule_pending(timeout=0.2)
+    assert out[0].node == "node-1"
+    assert "NormalizeScore" in points_called("pod-a")
+    sched.close()
+
+
+def test_prefilter_failure_skips_everything_else():
+    store, sched = build_sched(fail_at="PreFilter")
+    store.add(hollow.make_pod("pod-a"))
+    out = sched.schedule_pending(timeout=0.2)
+    assert len(out) == 1 and not out[0].node
+    assert "injected prefilter failure" in (out[0].err or "")
+    seq = points_called("pod-a")
+    assert seq.count("PreFilter") == 1
+    assert "Filter" not in seq and "Reserve" not in seq
+    sched.close()
+
+
+def test_filter_failure_fails_pod_and_runs_postfilter():
+    store, sched = build_sched(fail_at="Filter")
+    store.add(hollow.make_pod("pod-a"))
+    out = sched.schedule_pending(timeout=0.2)
+    assert len(out) == 1 and not out[0].node
+    seq = points_called("pod-a")
+    assert "Filter" in seq
+    assert "PostFilter" in seq          # unschedulable -> PostFilter runs
+    assert "Reserve" not in seq
+    sched.close()
+
+
+def test_per_node_filter_steers_placement():
+    store, sched = build_sched(fail_at="Filter:node-0")
+    store.add(hollow.make_pod("pod-a"))
+    out = sched.schedule_pending(timeout=0.2)
+    assert out[0].node == "node-1"
+    sched.close()
+
+
+def test_reserve_failure_unreserves_and_fails():
+    store, sched = build_sched(fail_at="Reserve")
+    store.add(hollow.make_pod("pod-a"))
+    out = sched.schedule_pending(timeout=0.2)
+    assert len(out) == 1 and not out[0].node
+    seq = points_called("pod-a")
+    assert "Reserve" in seq and "Unreserve" in seq
+    assert seq.index("Reserve") < seq.index("Unreserve")
+    assert "PreBind" not in seq and "Bind" not in seq
+    # commit failures never nominate preemption (scheduler.go:542)
+    assert "PostFilter" not in seq
+    sched.close()
+
+
+def test_prebind_failure_unreserves_and_forgets():
+    store, sched = build_sched(fail_at="PreBind")
+    store.add(hollow.make_pod("pod-a"))
+    out = sched.schedule_pending(timeout=0.2)
+    assert len(out) == 1 and not out[0].node
+    seq = points_called("pod-a")
+    assert "PreBind" in seq and "Unreserve" in seq
+    assert "Bind" not in seq and "PostBind" not in seq
+    assert store.get_pod("default", "pod-a").spec.node_name == ""
+    sched.close()
+
+
+def test_bind_failure_unreserves():
+    store, sched = build_sched(fail_at="Bind")
+    store.add(hollow.make_pod("pod-a"))
+    out = sched.schedule_pending(timeout=0.2)
+    assert len(out) == 1 and not out[0].node
+    seq = points_called("pod-a")
+    assert "Bind" in seq and "Unreserve" in seq
+    assert "PostBind" not in seq
+    sched.close()
+
+
+def test_bind_skip_falls_through_to_default_binder():
+    store, sched = build_sched()
+    store.add(hollow.make_pod("pod-a"))
+    out = sched.schedule_pending(timeout=0.2)
+    assert out[0].node
+    # the injected plugin returned SKIP; DefaultBinder actually bound
+    assert store.get_pod("default", "pod-a").spec.node_name == out[0].node
+    assert "PostBind" in points_called("pod-a")
+    sched.close()
