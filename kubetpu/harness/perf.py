@@ -393,11 +393,23 @@ def main(argv: Optional[List[str]] = None) -> None:
         workloads = [w for w in workloads if args.only.lower() in
                      w.name.lower()]
     all_items = []
+    failed: List[str] = []
     for w in workloads:
         if args.verbose:
             print(f"running {w.name} ({w.num_nodes} nodes, "
                   f"{w.num_pods_to_schedule} pods)...", flush=True)
-        items = run_workload(w, verbose=args.verbose)
+        try:
+            items = run_workload(w, verbose=args.verbose)
+        except Exception as e:
+            # one failed workload must not lose the rest of the matrix —
+            # record it, keep going, and exit non-zero at the end
+            import sys as _sys
+            print(f"  {w.name} FAILED: {e}", file=_sys.stderr, flush=True)
+            failed.append(w.name)
+            items = [DataItem(data=_stats([]), unit="pods/s",
+                              labels={"Name": w.name,
+                                      "Metric": "SchedulingThroughput",
+                                      "Error": str(e)})]
         all_items.extend(items)
     doc = {"version": "v1",
            "dataItems": [it.to_doc() for it in all_items]}
@@ -406,6 +418,11 @@ def main(argv: Optional[List[str]] = None) -> None:
         with open(args.out, "w") as f:
             f.write(text)
     print(text)
+    if failed:
+        import sys as _sys
+        print(f"{len(failed)} workload(s) failed: {', '.join(failed)}",
+              file=_sys.stderr)
+        _sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -1,9 +1,58 @@
-"""Test configuration: force an 8-device virtual CPU mesh so multi-chip
-sharding paths are exercised without TPU hardware (the driver separately
-dry-runs the multi-chip path; see __graft_entry__.py)."""
+"""Test configuration.
+
+Two concerns (VERDICT r4 #10 — suite wall time):
+
+1. An 8-device virtual CPU mesh so multi-chip sharding paths are
+   exercised without TPU hardware (the driver separately dry-runs the
+   multi-chip path; see __graft_entry__.py).
+
+2. Backend routing: the environment's sitecustomize force-registers the
+   tunneled TPU backend and DEFEATS the JAX_PLATFORMS=cpu env pin, so
+   pure-semantics tests were compiling tiny programs on the shared chip
+   and paying ~100 ms tunnel latency per readback.  The autouse fixture
+   below pins everything to the in-process CPU backend — via the GLOBAL
+   jax_default_device config, not the thread-local context manager,
+   because the scheduler's serving/bind/prewarm threads would escape a
+   thread-local pin — EXCEPT the device-path modules (serving loop,
+   auction, chaining, placement goldens), which keep real-TPU coverage
+   and whose checked-in traces were generated there.  Modules that never
+   import jax skip the pin entirely (no backend init for pure-Python
+   tests).
+"""
 import os
+import sys
+
+import pytest
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# modules that must run on the real device when one is present: the
+# serving/device path (and goldens whose traces were recorded on it)
+TPU_MODULES = {
+    "test_gang", "test_chain", "test_scheduler", "test_sequential",
+    "test_graft_entry", "test_mesh", "test_placement_goldens",
+    "test_observability", "test_compile_cache",
+}
+
+
+@pytest.fixture(autouse=True)
+def _route_backend(request):
+    mod = request.module.__name__.rsplit(".", 1)[-1]
+    # don't initialize any backend for tests that never touch jax
+    if mod in TPU_MODULES or "jax" not in sys.modules:
+        yield
+        return
+    import jax
+    try:
+        cpu = jax.devices("cpu")[0]
+    except RuntimeError:
+        yield
+        return
+    jax.config.update("jax_default_device", cpu)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_default_device", None)
