@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import statistics
+import sys
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -403,26 +404,29 @@ def main(argv: Optional[List[str]] = None) -> None:
         except Exception as e:
             # one failed workload must not lose the rest of the matrix —
             # record it, keep going, and exit non-zero at the end
-            import sys as _sys
-            print(f"  {w.name} FAILED: {e}", file=_sys.stderr, flush=True)
+            print(f"  {w.name} FAILED: {e}", file=sys.stderr, flush=True)
             failed.append(w.name)
             items = [DataItem(data=_stats([]), unit="pods/s",
                               labels={"Name": w.name,
                                       "Metric": "SchedulingThroughput",
                                       "Error": str(e)})]
         all_items.extend(items)
+        if args.out:
+            # incremental write: a crash mid-matrix (e.g. a TPU worker
+            # fault an hour in) must not lose the completed workloads
+            with open(args.out, "w") as f:
+                json.dump({"version": "v1",
+                           "dataItems": [it.to_doc() for it in all_items]},
+                          f, indent=2)
+    # the incremental per-workload writes already left the complete file
+    # at args.out; just print the doc
     doc = {"version": "v1",
            "dataItems": [it.to_doc() for it in all_items]}
-    text = json.dumps(doc, indent=2)
-    if args.out:
-        with open(args.out, "w") as f:
-            f.write(text)
-    print(text)
+    print(json.dumps(doc, indent=2))
     if failed:
-        import sys as _sys
         print(f"{len(failed)} workload(s) failed: {', '.join(failed)}",
-              file=_sys.stderr)
-        _sys.exit(1)
+              file=sys.stderr)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

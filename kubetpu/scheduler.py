@@ -1183,14 +1183,23 @@ class Scheduler:
 
         # binding cycle (reference: scheduler.go:628 goroutine)
         if self._async_binding:
-            fut = self._bind_pool.submit(self._bind_cycle, fwk, qp, state,
-                                         assumed, node_name, binder_override)
-            # prune completed futures so a long-running scheduler doesn't
-            # retain one CycleState + pod copy per scheduled pod
-            self._inflight_binds = [f for f in self._inflight_binds
-                                    if not f.done()]
-            self._inflight_binds.append(fut)
-            err = None
+            try:
+                fut = self._bind_pool.submit(self._bind_cycle, fwk, qp,
+                                             state, assumed, node_name,
+                                             binder_override)
+            except RuntimeError:
+                # close() raced the serving loop and shut the pool down
+                # mid-cycle: bind synchronously so the placement still
+                # lands instead of panicking the cycle
+                err = self._bind_cycle(fwk, qp, state, assumed, node_name,
+                                       binder_override)
+            else:
+                # prune completed futures so a long-running scheduler
+                # doesn't retain one CycleState + pod copy per pod
+                self._inflight_binds = [f for f in self._inflight_binds
+                                        if not f.done()]
+                self._inflight_binds.append(fut)
+                err = None
         else:
             err = self._bind_cycle(fwk, qp, state, assumed, node_name,
                                    binder_override)

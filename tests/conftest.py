@@ -41,8 +41,11 @@ TPU_MODULES = {
 @pytest.fixture(autouse=True)
 def _route_backend(request):
     mod = request.module.__name__.rsplit(".", 1)[-1]
-    # don't initialize any backend for tests that never touch jax
-    if mod in TPU_MODULES or "jax" not in sys.modules:
+    # don't initialize any backend for tests that never touch jax;
+    # kubetpu imports jax transitively, so either name in sys.modules
+    # means this test session is jax-bearing (covers lazy importers too)
+    if mod in TPU_MODULES or not ("jax" in sys.modules
+                                  or "kubetpu" in sys.modules):
         yield
         return
     import jax
