@@ -362,6 +362,18 @@ DEFAULT_WORKLOADS: List[Workload] = [
 ]
 
 
+def _write_doc(path: str, items: List[DataItem]) -> None:
+    """Atomic checkpoint write: a crash mid-matrix (e.g. a TPU worker
+    fault an hour in) must not lose — or truncate — the completed
+    workloads' results."""
+    import os
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"version": "v1",
+                   "dataItems": [it.to_doc() for it in items]}, f, indent=2)
+    os.replace(tmp, path)
+
+
 def load_workloads(path: str) -> List[Workload]:
     import yaml
     with open(path) as f:
@@ -412,14 +424,11 @@ def main(argv: Optional[List[str]] = None) -> None:
                                       "Error": str(e)})]
         all_items.extend(items)
         if args.out:
-            # incremental write: a crash mid-matrix (e.g. a TPU worker
-            # fault an hour in) must not lose the completed workloads
-            with open(args.out, "w") as f:
-                json.dump({"version": "v1",
-                           "dataItems": [it.to_doc() for it in all_items]},
-                          f, indent=2)
-    # the incremental per-workload writes already left the complete file
-    # at args.out; just print the doc
+            _write_doc(args.out, all_items)
+    if args.out and not workloads:
+        # zero workloads ran (e.g. --only matched nothing): still refresh
+        # the file so a stale previous run can't masquerade as current
+        _write_doc(args.out, all_items)
     doc = {"version": "v1",
            "dataItems": [it.to_doc() for it in all_items]}
     print(json.dumps(doc, indent=2))
