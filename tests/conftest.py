@@ -32,9 +32,9 @@ if "host_platform_device_count" not in flags:
 # modules that must run on the real device when one is present: the
 # serving/device path (and goldens whose traces were recorded on it)
 TPU_MODULES = {
-    "test_gang", "test_chain", "test_scheduler", "test_sequential",
+    "test_gang", "test_chain", "test_scheduler",
     "test_graft_entry", "test_mesh", "test_placement_goldens",
-    "test_observability", "test_compile_cache",
+    "test_compile_cache",
 }
 
 
