@@ -1,0 +1,21 @@
+# Developer entrypoints.  `make lint` is the static-analysis gate builders
+# run by default; `make test` is the tier-1 suite (which embeds the same
+# lint gate via tests/test_kubelint.py).
+
+.PHONY: lint test sanitize-test bench
+
+lint:
+	./tools/ci_lint.sh
+
+test:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider
+
+# full scheduling cycles under the runtime sanitizer (debug_nans,
+# rank_promotion=raise, compile-count watchdog)
+sanitize-test:
+	JAX_PLATFORMS=cpu KUBETPU_SANITIZE=1 python -m pytest \
+		tests/test_sanitize.py -q -p no:cacheprovider
+
+bench:
+	python bench.py

@@ -264,7 +264,7 @@ def run_scores(cluster, batch, cfg: ProgramConfig, feasible, affinity_ok,
             s = K.node_label_score(cluster, batch, prefs)
         else:
             raise ValueError(f"unknown score kernel {name}")
-        s = jnp.where(feasible, s, 0.0) * float(weight)
+        s = jnp.where(feasible, s, 0.0) * float(weight)  # kubelint: ignore[host-sync/cast] trace-time constant: weight is a static int from cfg.scores (jit static arg)
         per_plugin[name] = s
         total = total + s
     return total, per_plugin

@@ -526,13 +526,13 @@ def _schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
             have_zones = jnp.any(feas & has_zone)
             max_zone = jnp.maximum(jnp.max(zcounts), 0.0)
             f_score = jnp.where(max_node > 0,
-                                K.MAX_NODE_SCORE * (max_node - raw)
+                                K.MAX_NODE_SCORE * (max_node - raw)  # kubelint: ignore[numeric/score-div] reference computes fScore in float64 (default_pod_topology_spread.go:126); floor lands after the zone combine
                                 / jnp.maximum(max_node, 1.0), K.MAX_NODE_SCORE)
             nzc = jnp.einsum("z,nz->n", zcounts, zh,
                              precision=jax.lax.Precision.HIGHEST,
                              preferred_element_type=jnp.float32)
             z_score = jnp.where(max_zone > 0,
-                                K.MAX_NODE_SCORE * (max_zone - nzc)
+                                K.MAX_NODE_SCORE * (max_zone - nzc)  # kubelint: ignore[numeric/score-div] reference computes zoneScore in float64 (default_pod_topology_spread.go:142); floor lands after the combine
                                 / jnp.maximum(max_zone, 1.0), K.MAX_NODE_SCORE)
             wz = (f_score * (1.0 - K.ZONE_WEIGHTING)) + K.ZONE_WEIGHTING * z_score
             s = jnp.floor(jnp.where(have_zones & has_zone, wz, f_score))

@@ -36,7 +36,7 @@ def _idiv(a, b):
     x * (1/b), and e.g. 200 * (1/100) = 1.9999999 floors to 1.  Both
     operands here are exact integers in f32 range, so one remainder
     correction recovers the exact quotient."""
-    q = jnp.floor(a / b)
+    q = jnp.floor(a / b)  # kubelint: ignore[numeric/floor-div] this IS the corrected division — the remainder fixup below recovers the exact quotient
     r = a - q * b
     return q + jnp.where(r >= b, 1.0, 0.0) - jnp.where(r < 0, 1.0, 0.0)
 
@@ -156,12 +156,17 @@ def fit_rows(req: jnp.ndarray, avail: jnp.ndarray) -> jnp.ndarray:
     scalar channels only when requested)."""
     free_ok = avail >= req
     R = req.shape[-1]
-    ch = jnp.arange(R)
+    # channel masks broadcast EXPLICITLY against the [..., R] operands:
+    # bare [R] | [X, R] is an implicit rank promotion the sanitizer
+    # (KUBETPU_SANITIZE rank_promotion="raise") rejects
+    shape1 = (1,) * (req.ndim - 1) + (R,)
+    ch = jnp.arange(R).reshape(shape1)
     is_fixed = (ch < N_FIXED_CHANNELS) & (ch != CH_PODS)
+    is_pods = ch == CH_PODS
     check = jnp.where(is_fixed, True, req > 0)
-    res_ok = jnp.all(free_ok | ~check | (ch == CH_PODS), axis=-1)
+    res_ok = jnp.all(free_ok | ~check | is_pods, axis=-1)
     pods_ok = free_ok[..., CH_PODS]
-    nonpods = jnp.where(ch == CH_PODS, 0.0, req)
+    nonpods = jnp.where(is_pods, 0.0, req)
     zero_req = jnp.all(nonpods == 0, axis=-1)
     return pods_ok & (zero_req | res_ok)
 
@@ -172,17 +177,18 @@ def fit_filter(cluster, batch, ignored_channels: jnp.ndarray | None = None) -> j
     alloc, used, req = cluster.allocatable, cluster.requested, batch.req
     free_ok = alloc[None, :, :] >= req[:, None, :] + used[None, :, :]  # [B, N, R]
     R = alloc.shape[1]
-    ch = jnp.arange(R)
+    ch = jnp.arange(R)[None, None, :]  # explicit [1, 1, R] broadcast
     # pod count is always checked; cpu/mem/ephemeral checked whenever the pod
     # requests anything at all; scalar channels only when requested.
     is_fixed = (ch < N_FIXED_CHANNELS) & (ch != CH_PODS)
+    is_pods = ch == CH_PODS
     scalar_req = req[:, None, :] > 0
     check = jnp.where(is_fixed, True, scalar_req)
     if ignored_channels is not None:
-        check = jnp.logical_and(check, ignored_channels > 0)
-    res_ok = jnp.all(free_ok | ~check | (ch == CH_PODS), axis=-1)
+        check = jnp.logical_and(check, (ignored_channels > 0)[None, None, :])
+    res_ok = jnp.all(free_ok | ~check | is_pods, axis=-1)
     pods_ok = free_ok[:, :, CH_PODS]
-    nonpods = jnp.where(ch == CH_PODS, 0.0, req)
+    nonpods = jnp.where(is_pods[0], 0.0, req)
     zero_req = jnp.all(nonpods == 0, axis=-1)  # [B]
     return pods_ok & (zero_req[:, None] | res_ok)
 
@@ -804,14 +810,14 @@ def default_spread_normalize(cluster, batch, raw, feasible) -> jnp.ndarray:
     max_zone = jnp.maximum(jnp.max(counts_by_zone, axis=1, keepdims=True), 0.0)
 
     f_score = jnp.where(max_node > 0,
-                        MAX_NODE_SCORE * (max_node - raw) / jnp.maximum(max_node, 1.0),
+                        MAX_NODE_SCORE * (max_node - raw) / jnp.maximum(max_node, 1.0),  # kubelint: ignore[numeric/score-div] reference computes fScore in float64 (default_pod_topology_spread.go:126); floor lands after the zone combine
                         MAX_NODE_SCORE)
     # one nonzero term per output (one-hot) => exact regardless of precision
     node_zone_count = jnp.einsum("bz,nz->bn", counts_by_zone, zh,
                                  precision=jax.lax.Precision.HIGHEST,
                                  preferred_element_type=jnp.float32)
     zone_score = jnp.where(max_zone > 0,
-                           MAX_NODE_SCORE * (max_zone - node_zone_count)
+                           MAX_NODE_SCORE * (max_zone - node_zone_count)  # kubelint: ignore[numeric/score-div] reference computes zoneScore in float64 (default_pod_topology_spread.go:142); floor lands after the combine
                            / jnp.maximum(max_zone, 1.0),
                            MAX_NODE_SCORE)
     with_zone = (f_score * (1.0 - ZONE_WEIGHTING)) + ZONE_WEIGHTING * zone_score
@@ -854,13 +860,13 @@ def broken_linear(p, shape):
     buildBrokenLinearFunction).  shape: static tuple of (utilization, score).
     Decreasing segments produce negative deltas, so the division must
     truncate toward zero like Go's, not floor."""
-    out = jnp.full_like(p, float(shape[-1][1]))
+    out = jnp.full_like(p, float(shape[-1][1]))  # kubelint: ignore[host-sync/cast] trace-time constant: shape is the static plugin-args tuple
     for i in range(len(shape) - 1, -1, -1):
-        u_i, s_i = float(shape[i][0]), float(shape[i][1])
+        u_i, s_i = float(shape[i][0]), float(shape[i][1])  # kubelint: ignore[host-sync/cast] trace-time constant: shape is the static plugin-args tuple
         if i == 0:
             seg = jnp.full_like(p, s_i)
         else:
-            u_p, s_p = float(shape[i - 1][0]), float(shape[i - 1][1])
+            u_p, s_p = float(shape[i - 1][0]), float(shape[i - 1][1])  # kubelint: ignore[host-sync/cast] trace-time constant: shape is the static plugin-args tuple
             seg = s_p + _itrunc((s_i - s_p) * (p - u_p), u_i - u_p)
         out = jnp.where(p <= u_i, seg, out)
     return out
@@ -883,7 +889,7 @@ def rtcr_combine(parts, shape):
         s = jnp.where((cap <= 0) | (req > cap),
                       broken_linear(jnp.full_like(util, 100.0), shape), s)
         contrib = jnp.where(s > 0, s * weight, 0.0)
-        w = jnp.where(s > 0, float(weight), 0.0)
+        w = jnp.where(s > 0, float(weight), 0.0)  # kubelint: ignore[host-sync/cast] trace-time constant: weight comes from the static resources tuple
         total = contrib if total is None else total + contrib
         weight_sum = w if weight_sum is None else weight_sum + w
     return jnp.where(weight_sum > 0,

@@ -70,7 +70,7 @@ class SelectorSet(NamedTuple):
 def match_selectors(sel: SelectorSet,
                     kv: jnp.ndarray,      # [M, L] bool/float — target has (key,value)
                     key: jnp.ndarray,     # [M, K] bool/float — target has key
-                    num: Optional[jnp.ndarray] = None,  # [M, K] f32 numeric label values (NaN = non-numeric)
+                    num: Optional[jnp.ndarray] = None,  # [M, K] f32 numeric label values (+inf = non-numeric)
                     ) -> jnp.ndarray:
     """Match S selector slots against M targets -> [S, M] bool.
 
@@ -105,7 +105,9 @@ def match_selectors_unique(sel: SelectorSet,
         is_gt = sel.num_op[..., None] == 1
         cmp = jnp.where(is_gt, nval > sel.num_val[..., None],
                         nval < sel.num_val[..., None])
-        cmp = jnp.logical_and(cmp, jnp.logical_not(jnp.isnan(nval)))
+        # absent/non-numeric labels are +inf (NaN-free cluster contract,
+        # state/tensors.py): isfinite fails them for both Gt and Lt
+        cmp = jnp.logical_and(cmp, jnp.isfinite(nval))
         ok = jnp.where(sel.num_op[..., None] > 0, cmp, ok)
 
     ok = jnp.logical_or(ok, jnp.logical_not(sel.req_valid[..., None]))

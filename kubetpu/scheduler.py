@@ -863,7 +863,6 @@ class Scheduler:
         n_nodes = len(node_infos)
         B = prep.batch.valid.shape[0]
         outcomes: List[ScheduleOutcome] = []
-        chosen_full = packed[:B]
         if self.config.mode != "gang":
             self._next_start_node_index = int(packed[3 * B])
         else:
@@ -873,9 +872,12 @@ class Scheduler:
             self.device_flops += gang_cycle_flops(
                 prep.cluster, prep.batch, prep.cfg, self.last_gang_rounds,
                 intra_batch_topology=prep.needs_topo)
-        chosen = chosen_full[:len(live)]
-        n_feas = packed[B:2 * B][:len(live)]
-        unres = packed[2 * B:3 * B][:len(live)].astype(bool)
+        # one .tolist() per field: the commit loop below reads every entry,
+        # and plain Python ints beat a numpy scalar box per access at 4k
+        # pods/cycle (kubelint host-sync audit)
+        chosen = packed[:B][:len(live)].tolist()
+        n_feas = packed[B:2 * B][:len(live)].tolist()
+        unres = (packed[2 * B:3 * B][:len(live)] != 0).tolist()
         trace.step("Computing predicates and priorities on device done")
 
         # ---- commit each placement in scan order; failures DEFER until
@@ -890,16 +892,16 @@ class Scheduler:
                 outcomes.append(None)
                 deferred.append((len(outcomes) - 1, qp, state,
                                  f"0/{n_nodes} nodes are available",
-                                 not bool(unres[i])))
+                                 not unres[i]))
                 continue
-            node_name = node_infos[int(chosen[i])].node_name
+            node_name = node_infos[chosen[i]].node_name
             outcome = self._commit(fwk, qp, state, node_name,
-                                   int(n_feas[i]), pinfo=pinfos[i],
+                                   n_feas[i], pinfo=pinfos[i],
                                    host_relevant=prep.host_relevant[qp.pod.uid])
             if outcome.node:
                 # preemption for pods failing later in this batch must see
                 # this placement (CycleContext.cluster_now overlay)
-                cycle_ctx.note_commit(i, int(chosen[i]))
+                cycle_ctx.note_commit(i, chosen[i])
             else:
                 commit_failed = True
             outcomes.append(outcome)
@@ -938,23 +940,29 @@ class Scheduler:
                 cluster, batch, cfg,
                 self._jax.numpy.asarray(host_ok) if host_ok is not None
                 else None)
-        feasible = np.asarray(res.feasible)
-        scores = np.asarray(res.scores)
+        # ONE batched readback for the whole group, then Python lists: a
+        # per-element float(scores[i, j]) in the per-pod loop below would
+        # box B x N numpy scalars (and, pre-np.asarray, would cost one
+        # device sync each — the kubelint host-sync/loop-readback trap)
+        feasible = np.asarray(res.feasible).tolist()
+        scores = np.asarray(res.scores).tolist()
         n_nodes = len(node_infos)
         row_of_node = {ni.node_name: j for j, ni in enumerate(node_infos)}
         outcomes: List[ScheduleOutcome] = []
         for i, qp in enumerate(live):
             state = states[qp.pod.uid]
+            row_feas = feasible[i]
             names = [node_infos[j].node_name for j in range(n_nodes)
-                     if feasible[i, j]]
+                     if row_feas[j]]
             # the device mask is pre-batch: re-check fit against the LIVE
             # node usage (includes earlier same-batch assumes) so two pods
             # in one extender batch cannot oversubscribe a node
             pod_res = PodInfo(qp.pod).resource
             names = [n for n in names
                      if self._fits_live(pod_res, self.cache.node_fit_view(n))]
-            dev_score = {node_infos[j].node_name: float(scores[i, j])
-                         for j in range(n_nodes) if feasible[i, j]}
+            row_scores = scores[i]
+            dev_score = {node_infos[j].node_name: row_scores[j]
+                         for j in range(n_nodes) if row_feas[j]}
             exts = [e for e in self.extenders if e.is_interested(qp.pod)]
             err = None
             try:

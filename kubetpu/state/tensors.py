@@ -85,7 +85,11 @@ class ClusterTensors(NamedTuple):
     unschedulable: jnp.ndarray      # [N] bool (.spec.unschedulable)
     kv: jnp.ndarray                 # [N, L] bool — node has label (k,v)
     keymask: jnp.ndarray            # [N, K] bool — node has label key
-    num: jnp.ndarray                # [N, K] f32 — numeric label value (NaN if not)
+    num: jnp.ndarray                # [N, K] f32 — numeric label value (+inf
+                                    # when absent/non-numeric: keeps cluster
+                                    # tensors NaN-free so the sanitizer's
+                                    # jax_debug_nans pass stays meaningful;
+                                    # selectors guard with isfinite)
     topo_pair: jnp.ndarray          # [N, TK] i32 — kv id of (topokey, value), -1 absent
     taints: jnp.ndarray             # [N, T] bool
     ports: jnp.ndarray              # [N, P] bool
@@ -275,7 +279,7 @@ class SnapshotBuilder:
             "_pod_kv_ids": np.full((PP, MLp), -1, np.int32),
             "_kv_cap": L,
             "keymask": np.zeros((N, K), bool),
-            "num": np.full((N, K), np.nan, np.float32),
+            "num": np.full((N, K), np.inf, np.float32),
             "topo_pair": np.full((N, TK), -1, np.int32),
             "taints": np.zeros((N, T), bool),
             "ports": np.zeros((N, P), bool),

@@ -1,0 +1,259 @@
+"""Runtime sanitizer harness: the dynamic half of the kubelint contract.
+
+kubelint (tools/kubelint) proves hot-path invariants statically; this
+module enforces the ones only a live trace can check, behind one opt-in
+switch (``KUBETPU_SANITIZE=1``):
+
+  * ``jax_debug_nans`` — a NaN anywhere in filter/score math means a
+    broken kernel (every score is finite by construction); fail loudly at
+    the producing primitive instead of binding a garbage placement.
+  * ``jax_numpy_rank_promotion="raise"`` — every broadcast in the kernels
+    is explicit (``[None, :]``); an implicit rank promotion is almost
+    always a transposed operand riding a silent broadcast.
+  * donation-mismatch logging — a donated buffer XLA could not reuse
+    means the donation annotation and the program disagree; surfaced
+    every time instead of Python's warn-once default.
+  * a per-program compile-count watchdog — with pow2 bucketing
+    (utils/intern.py) every jitted program must compile AT MOST ONCE per
+    (program, shape-bucket) key per process; a second compile of the same
+    key means the jit cache is being defeated (fresh jit objects,
+    unhashable statics, dtype drift).  Tests run a scheduling cycle under
+    the sanitizer and fail on any recompilation.
+
+The sanitizer deliberately does NOT flip ``jax_enable_x64`` — the scoring
+pipeline is calibrated for f32 (see ops/kernels.py) — and restores every
+config flag it touched on ``disable_sanitizer()``/context exit, so test
+suites can scope it to single cases.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import threading
+import warnings
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
+
+ENV_FLAG = "KUBETPU_SANITIZE"
+
+# the logger jax routes compilation progress through (jax 0.4.x); records
+# look like "Compiling <name> with global shapes and types [ShapedArray(
+# f32[8,16])...]. Argument mapping: ..."
+_PXLA_LOGGER = "jax._src.interpreters.pxla"
+_COMPILE_RE = re.compile(
+    r"Compiling (\S+) with global shapes and types (\[.*\])\.\s*"
+    r"Argument mapping", re.DOTALL)
+_DONATION_RE = re.compile(r"[Dd]onated buffers? .*not usable|"
+                          r"buffer donat\w+ .*mismatch")
+
+
+class CompileWatchdog(logging.Handler):
+    """Counts XLA compilations per (program name, shape signature) and
+    donation-mismatch complaints, from jax's own compilation log stream.
+
+    The handler listens at DEBUG on the pxla logger (jax emits the compile
+    record at DEBUG unless jax_log_compiles is set), so installing it does
+    not add stderr noise — ancestor handlers keep their own levels.
+
+    Known coarseness: the compile record does not include jit STATIC
+    argument keys, so two compiles of one program at identical shapes but
+    different static configs count as a recompile.  That is deliberate
+    for the serving contract (a cycle's ProgramConfig is stable; churning
+    statics per cycle IS a compile-cache defeat), but scoped test
+    contexts should start from fresh counts — ``sanitized()`` resets the
+    watchdog when it joins an already-armed sanitizer."""
+
+    def __init__(self):
+        super().__init__(level=logging.DEBUG)
+        self._lock = threading.Lock()
+        self.counts: Dict[Tuple[str, str], int] = {}
+        self.donation_mismatches: List[str] = []
+
+    # logging.Handler interface ----------------------------------------
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            msg = record.getMessage()
+        except Exception:
+            return
+        m = _COMPILE_RE.search(msg)
+        if m:
+            key = (m.group(1), m.group(2))
+            with self._lock:
+                self.counts[key] = self.counts.get(key, 0) + 1
+            return
+        if _DONATION_RE.search(msg):
+            with self._lock:
+                self.donation_mismatches.append(msg)
+            logging.getLogger("kubetpu.sanitize").warning(
+                "donation mismatch: %s", msg)
+
+    # warnings interface (jax emits donation mismatches via warnings.warn,
+    # not logging — see enable_sanitizer's showwarning hook) -------------
+    def note_warning(self, message: str) -> None:
+        if _DONATION_RE.search(message):
+            with self._lock:
+                self.donation_mismatches.append(message)
+            logging.getLogger("kubetpu.sanitize").warning(
+                "donation mismatch: %s", message)
+
+    # assertions --------------------------------------------------------
+    def compile_count(self) -> int:
+        with self._lock:
+            return sum(self.counts.values())
+
+    def recompiled(self) -> Dict[Tuple[str, str], int]:
+        """(program, shapes) keys that compiled more than once — each one
+        is a defeated jit cache."""
+        with self._lock:
+            return {k: c for k, c in self.counts.items() if c > 1}
+
+    def assert_no_recompilation(self) -> None:
+        bad = self.recompiled()
+        if bad:
+            lines = ["%s compiled %d times for shapes %s" % (name, c, shapes)
+                     for (name, shapes), c in sorted(bad.items())]
+            raise AssertionError(
+                "compile-count watchdog: jit cache defeated —\n  "
+                + "\n  ".join(lines))
+
+    def reset(self) -> None:
+        with self._lock:
+            self.counts.clear()
+            self.donation_mismatches.clear()
+
+
+class _SanitizerState:
+    def __init__(self):
+        self.active = False
+        self.watchdog: Optional[CompileWatchdog] = None
+        self.prev_config: Dict[str, object] = {}
+        self.prev_logger_level: Optional[int] = None
+        self.prev_propagate: Optional[bool] = None
+        self.prev_warn_filters: Optional[list] = None
+        self.prev_showwarning = None
+
+
+_state = _SanitizerState()
+_state_lock = threading.Lock()
+
+
+def sanitize_enabled() -> bool:
+    return os.environ.get(ENV_FLAG, "0") not in ("", "0", "false", "False")
+
+
+def current_watchdog() -> Optional[CompileWatchdog]:
+    return _state.watchdog if _state.active else None
+
+
+_SANITIZE_FLAGS = (("jax_debug_nans", True),
+                   ("jax_numpy_rank_promotion", "raise"))
+
+
+def enable_sanitizer() -> CompileWatchdog:
+    """Idempotently turn the sanitizer on; returns the watchdog."""
+    import jax
+    with _state_lock:
+        if _state.active:
+            return _state.watchdog
+        for name, value in _SANITIZE_FLAGS:
+            _state.prev_config[name] = getattr(jax.config, name)
+            jax.config.update(name, value)
+        wd = CompileWatchdog()
+        # jax reports donation mismatches via warnings.warn (not logging):
+        # hook showwarning so the watchdog sees every one, and make them
+        # repeat-warn instead of Python's warn-once.  Both the filter list
+        # and the hook are restored on disable.
+        _state.prev_warn_filters = list(warnings.filters)
+        warnings.filterwarnings(
+            "always", message=r".*[Dd]onated buffers?.*")
+        _state.prev_showwarning = warnings.showwarning
+
+        def showwarning(message, category, filename, lineno, file=None,
+                        line=None, _prev=warnings.showwarning):
+            wd.note_warning(str(message))
+            return _prev(message, category, filename, lineno, file, line)
+
+        warnings.showwarning = showwarning
+        logger = logging.getLogger(_PXLA_LOGGER)
+        _state.prev_logger_level = logger.level
+        _state.prev_propagate = logger.propagate
+        if logger.level == logging.NOTSET or logger.level > logging.DEBUG:
+            # jax emits the compile record at DEBUG; opening the logger up
+            # would spray every record at ancestor HANDLERS (propagation
+            # skips ancestor logger levels), so keep them local to the
+            # watchdog while the sanitizer is on
+            logger.setLevel(logging.DEBUG)
+            logger.propagate = False
+        logger.addHandler(wd)
+        _state.watchdog = wd
+        _state.active = True
+        logging.getLogger("kubetpu.sanitize").info(
+            "sanitizer on: debug_nans, rank_promotion=raise, donation "
+            "logging, compile-count watchdog")
+        return wd
+
+
+def disable_sanitizer() -> None:
+    """Restore every flag/handler enable_sanitizer() touched."""
+    import jax
+    with _state_lock:
+        if not _state.active:
+            return
+        for name, value in _state.prev_config.items():
+            jax.config.update(name, value)
+        _state.prev_config.clear()
+        logger = logging.getLogger(_PXLA_LOGGER)
+        if _state.watchdog is not None:
+            logger.removeHandler(_state.watchdog)
+        if _state.prev_logger_level is not None:
+            logger.setLevel(_state.prev_logger_level)
+        if _state.prev_propagate is not None:
+            logger.propagate = _state.prev_propagate
+        if _state.prev_warn_filters is not None:
+            warnings.filters[:] = _state.prev_warn_filters
+        if _state.prev_showwarning is not None:
+            warnings.showwarning = _state.prev_showwarning
+        _state.prev_logger_level = None
+        _state.prev_propagate = None
+        _state.prev_warn_filters = None
+        _state.prev_showwarning = None
+        _state.watchdog = None
+        _state.active = False
+
+
+@contextmanager
+def sanitized():
+    """Scoped sanitizer for tests: restores config on exit.  If the
+    sanitizer was already active (e.g. armed process-wide via
+    KUBETPU_SANITIZE=1 at import), the context joins it and leaves it
+    running on exit instead of tearing it down.
+
+    ::
+
+        with sanitized() as watchdog:
+            run_cycle()
+            watchdog.assert_no_recompilation()
+    """
+    owned = not _state.active
+    wd = enable_sanitizer()
+    if not owned:
+        # joining a process-wide sanitizer: scope the counts so this
+        # block's assert_no_recompilation() judges only its own work
+        wd.reset()
+    try:
+        yield wd
+    finally:
+        if owned:
+            disable_sanitizer()
+
+
+def maybe_enable_from_env() -> Optional[CompileWatchdog]:
+    """Serving-path hook: enables the sanitizer iff KUBETPU_SANITIZE=1.
+    Called from kubetpu/__init__.py so every entry point (scheduler,
+    server, bench, harness) gets it without its own wiring.  Importing
+    this module never imports jax; enabling does."""
+    if sanitize_enabled():
+        return enable_sanitizer()
+    return None

@@ -1,0 +1,595 @@
+"""kubelint self-tests: every rule family fires on a known-bad snippet,
+stays quiet on the matching known-good one, the suppression syntax works,
+and — the tier-1 gate — the shipped ``kubetpu/`` tree is clean (every
+remaining finding carries an inline suppression with a reason)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools.kubelint import run_lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_snippet(tmp_path, src, rules=None):
+    f = tmp_path / "snippet.py"
+    f.write_text(src)
+    return run_lint([str(f)], root=str(tmp_path), rules=rules)
+
+
+def rule_ids(result):
+    return sorted({f.rule for f in result.findings})
+
+
+# ---------------------------------------------------------------------------
+# host-sync family
+
+
+HOST_SYNC_BAD = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+@jax.jit
+def kernel(x, y):
+    v = float(x)                 # cast on a possible tracer
+    s = jnp.sum(x)
+    if s > 0:                    # branch on a tracer
+        y = y + 1
+    w = s.item()                 # device sync
+    h = np.asarray(x)            # host materialization
+    return v + w + h
+"""
+
+HOST_SYNC_GOOD = """
+import functools
+import jax
+import jax.numpy as jnp
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def kernel(x, k):
+    n = x.shape[0]               # shapes are static under jit
+    v = float(k)                 # static_argnames param: fine
+    m = float(len(x))            # len() is static
+    if k > 2:                    # static branch
+        x = x * v
+    return jnp.where(x > 0, x, m) * n
+"""
+
+
+def test_host_sync_fires_on_bad(tmp_path):
+    res = lint_snippet(tmp_path, HOST_SYNC_BAD)
+    ids = rule_ids(res)
+    assert "host-sync/cast" in ids
+    assert "host-sync/traced-branch" in ids
+    assert "host-sync/item" in ids
+    assert "host-sync/asarray" in ids
+
+
+def test_host_sync_quiet_on_good(tmp_path):
+    res = lint_snippet(tmp_path, HOST_SYNC_GOOD, rules=["host-sync"])
+    assert res.clean, "\n".join(str(f) for f in res.findings)
+
+
+def test_traced_closure_reaches_helpers(tmp_path):
+    """A helper is traced because a jitted function calls it — the rule
+    fires inside the helper even though it has no decorator."""
+    src = """
+import jax
+
+def helper(x):
+    return float(x) + 1.0
+
+@jax.jit
+def entry(x):
+    return helper(x)
+"""
+    res = lint_snippet(tmp_path, src, rules=["host-sync"])
+    assert any(f.rule == "host-sync/cast" and "helper" in f.message
+               for f in res.findings)
+
+
+def test_scan_body_is_traced(tmp_path):
+    """Functions handed to lax.scan/while_loop are roots too."""
+    src = """
+import jax
+import jax.numpy as jnp
+
+def run(xs):
+    def step(carry, x):
+        bad = int(x)
+        return carry + bad, x
+    return jax.lax.scan(step, 0.0, xs)
+"""
+    res = lint_snippet(tmp_path, src, rules=["host-sync"])
+    assert any(f.rule == "host-sync/cast" for f in res.findings)
+
+
+def test_loop_readback_fires(tmp_path):
+    src = """
+import jax
+
+@jax.jit
+def program(x):
+    return x * 2
+
+def drain(x, n):
+    res = program(x)
+    out = []
+    for i in range(n):
+        out.append(float(res[i]))
+    return out
+"""
+    res = lint_snippet(tmp_path, src, rules=["host-sync"])
+    assert any(f.rule == "host-sync/loop-readback" for f in res.findings)
+
+
+def test_loop_readback_quiet_after_asarray(tmp_path):
+    src = """
+import jax
+import numpy as np
+
+@jax.jit
+def program(x):
+    return x * 2
+
+def drain(x, n):
+    res = np.asarray(program(x))
+    return [float(res[i]) for i in range(n)]
+"""
+    res = lint_snippet(tmp_path, src, rules=["host-sync"])
+    assert res.clean, "\n".join(str(f) for f in res.findings)
+
+
+# ---------------------------------------------------------------------------
+# recompile family
+
+
+def test_jit_in_body_fires(tmp_path):
+    src = """
+import jax
+
+def serve(xs):
+    out = []
+    for x in xs:
+        f = jax.jit(lambda v: v + 1)
+        out.append(f(x))
+    return out
+"""
+    res = lint_snippet(tmp_path, src, rules=["recompile"])
+    assert any(f.rule == "recompile/jit-in-body" for f in res.findings)
+
+
+def test_jit_decorator_quiet(tmp_path):
+    src = """
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def f(x, k=3):
+    return x * k
+
+g = jax.jit(f)
+"""
+    res = lint_snippet(tmp_path, src, rules=["recompile"])
+    assert res.clean, "\n".join(str(f) for f in res.findings)
+
+
+def test_nonhashable_static_fires(tmp_path):
+    src = """
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def f(x, cfg=None):
+    return x
+
+def call(x):
+    return f(x, cfg=["a", "b"])
+"""
+    res = lint_snippet(tmp_path, src, rules=["recompile"])
+    assert any(f.rule == "recompile/nonhashable-static"
+               for f in res.findings)
+
+
+def test_nonhashable_static_default_fires(tmp_path):
+    src = """
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def f(x, cfg=[1, 2]):
+    return x
+"""
+    res = lint_snippet(tmp_path, src, rules=["recompile"])
+    assert any(f.rule == "recompile/nonhashable-static"
+               for f in res.findings)
+
+
+def test_unbucketed_static_fires_and_pow2_quiet(tmp_path):
+    src = """
+import functools
+import jax
+
+def pow2_bucket(n, minimum=8):
+    cap = minimum
+    while cap < n:
+        cap *= 2
+    return cap
+
+@functools.partial(jax.jit, static_argnames=("pad_to",))
+def grow(x, pad_to=0):
+    return x
+
+def bad(x, items):
+    return grow(x, pad_to=len(items))
+
+def good(x, items):
+    return grow(x, pad_to=pow2_bucket(len(items)))
+"""
+    res = lint_snippet(tmp_path, src, rules=["recompile"])
+    unbucketed = [f for f in res.findings
+                  if f.rule == "recompile/unbucketed-static"]
+    assert len(unbucketed) == 1  # only the bad() call site
+
+
+def test_positional_static_arg_checked(tmp_path):
+    """Static-arg hygiene applies to positional spellings too."""
+    src = """
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def f(x, cfg=None):
+    return x
+
+def call(x):
+    return f(x, ["a", "b"])
+"""
+    res = lint_snippet(tmp_path, src, rules=["recompile"])
+    assert any(f.rule == "recompile/nonhashable-static"
+               for f in res.findings)
+
+
+def test_call_form_jit_captures_static_params(tmp_path):
+    """f = jax.jit(g, static_argnames=...) marks g's static params, so a
+    float() on one is NOT a host-sync finding."""
+    src = """
+import jax
+
+def g(x, n):
+    return x * float(n)
+
+run = jax.jit(g, static_argnames=("n",))
+"""
+    res = lint_snippet(tmp_path, src, rules=["host-sync"])
+    assert res.clean, "\n".join(str(f) for f in res.findings)
+
+
+def test_shape_branch_fires(tmp_path):
+    src = """
+import jax
+
+def bound():
+    return 7
+
+@jax.jit
+def f(x):
+    if x.shape[0] > bound():
+        return x * 2
+    return x
+"""
+    res = lint_snippet(tmp_path, src, rules=["recompile"])
+    assert any(f.rule == "recompile/shape-branch" for f in res.findings)
+
+
+# ---------------------------------------------------------------------------
+# numeric family
+
+
+def test_numeric_f64_fires(tmp_path):
+    src = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x):
+    return x.astype(jnp.float64)
+"""
+    res = lint_snippet(tmp_path, src, rules=["numeric"])
+    assert any(f.rule == "numeric/f64" for f in res.findings)
+
+
+def test_numeric_floor_div_fires(tmp_path):
+    src = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(a, b):
+    return jnp.floor(a / b)
+"""
+    res = lint_snippet(tmp_path, src, rules=["numeric"])
+    assert any(f.rule == "numeric/floor-div" for f in res.findings)
+
+
+def test_numeric_score_div_fires(tmp_path):
+    src = """
+import jax
+import jax.numpy as jnp
+
+MAX_NODE_SCORE = 100.0
+
+@jax.jit
+def f(raw, max_c):
+    return MAX_NODE_SCORE * raw / max_c
+"""
+    res = lint_snippet(tmp_path, src, rules=["numeric"])
+    assert any(f.rule == "numeric/score-div" for f in res.findings)
+
+
+def test_numeric_x64_fires(tmp_path):
+    src = """
+import jax
+jax.config.update("jax_enable_x64", True)
+"""
+    res = lint_snippet(tmp_path, src, rules=["numeric"])
+    assert any(f.rule == "numeric/x64-enable" for f in res.findings)
+
+
+def test_numeric_quiet_on_idiv_style(tmp_path):
+    src = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def _idiv_like(a, b):
+    q = a * (1.0 / b)
+    return jnp.floor(q + 0.5)
+"""
+    res = lint_snippet(tmp_path, src, rules=["numeric"])
+    assert res.clean, "\n".join(str(f) for f in res.findings)
+
+
+# ---------------------------------------------------------------------------
+# purity family
+
+
+def test_purity_env_fires_in_kernel_module(tmp_path):
+    src = """
+import os
+import jax
+
+SCALE = float(os.environ.get("SCALE", "1.0"))
+
+@jax.jit
+def f(x):
+    return x * SCALE
+"""
+    res = lint_snippet(tmp_path, src, rules=["purity"])
+    assert any(f.rule == "purity/env-access" for f in res.findings)
+
+
+def test_purity_global_mutation_fires(tmp_path):
+    src = """
+import jax
+
+_CACHE = {}
+
+@jax.jit
+def f(x):
+    return x
+
+def helper(k, v):
+    global _COUNT
+    _COUNT = 1
+    _CACHE[k] = v
+    _CACHE.update({k: v})
+"""
+    res = lint_snippet(tmp_path, src, rules=["purity"])
+    kinds = [f.message for f in res.findings
+             if f.rule == "purity/global-mutate"]
+    assert len(kinds) >= 2  # global stmt + container mutation
+
+
+def test_purity_quiet_without_jit(tmp_path):
+    """A module with no jit roots (and outside ops/models) is not a kernel
+    module — env access there is framework/config code, not kernel code."""
+    src = """
+import os
+
+def configure():
+    return os.environ.get("MODE", "default")
+"""
+    res = lint_snippet(tmp_path, src, rules=["purity"])
+    assert res.clean
+
+
+# ---------------------------------------------------------------------------
+# suppression machinery
+
+
+def test_suppression_with_reason_suppresses(tmp_path):
+    src = """
+import jax
+
+@jax.jit
+def f(x, w):
+    return x * float(w)  # kubelint: ignore[host-sync/cast] w is static here
+"""
+    res = lint_snippet(tmp_path, src, rules=["host-sync"])
+    assert res.clean
+    assert any(f.rule == "host-sync/cast" and f.suppressed
+               for f in res.suppressed)
+
+
+def test_suppression_without_reason_is_a_finding(tmp_path):
+    src = """
+import jax
+
+@jax.jit
+def f(x, w):
+    return x * float(w)  # kubelint: ignore[host-sync/cast]
+"""
+    res = lint_snippet(tmp_path, src)
+    assert any(f.rule == "kubelint/bad-suppression" for f in res.findings)
+    # the underlying finding is NOT suppressed by a reason-less comment
+    assert any(f.rule == "host-sync/cast" for f in res.findings)
+
+
+def test_suppression_wrong_rule_does_not_mask(tmp_path):
+    src = """
+import jax
+
+@jax.jit
+def f(x, w):
+    return x * float(w)  # kubelint: ignore[numeric/f64] wrong family
+"""
+    res = lint_snippet(tmp_path, src, rules=["host-sync"])
+    assert any(f.rule == "host-sync/cast" for f in res.findings)
+
+
+def test_unused_suppression_is_reported(tmp_path):
+    src = """
+import jax
+
+@jax.jit
+def f(x):
+    return x + 1  # kubelint: ignore[host-sync/cast] nothing to suppress here
+"""
+    res = lint_snippet(tmp_path, src)
+    assert any(f.rule == "kubelint/unused-suppression"
+               for f in res.findings)
+
+
+def test_loop_readback_not_hidden_by_later_launder(tmp_path):
+    """Laundering a name to host AFTER the loop must not hide the
+    per-element sync inside it (flow-sensitive device map)."""
+    src = """
+import jax
+import numpy as np
+
+@jax.jit
+def program(x):
+    return x * 2
+
+def drain(x, n):
+    res = program(x)
+    total = 0.0
+    for i in range(n):
+        total += float(res[i])
+    res = np.asarray(res)
+    return total, res
+"""
+    res = lint_snippet(tmp_path, src, rules=["host-sync"])
+    assert any(f.rule == "host-sync/loop-readback" for f in res.findings)
+
+
+def test_standalone_suppression_covers_next_line(tmp_path):
+    src = """
+import jax
+
+@jax.jit
+def f(x, w):
+    # kubelint: ignore[host-sync/cast] w is a static weight
+    return x * float(w)
+"""
+    res = lint_snippet(tmp_path, src, rules=["host-sync"])
+    assert res.clean
+
+
+# ---------------------------------------------------------------------------
+# CLI + JSON mode
+
+
+def test_cli_json_mode(tmp_path):
+    f = tmp_path / "bad.py"
+    f.write_text("""
+import jax
+
+@jax.jit
+def f(x):
+    return float(x)
+""")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.kubelint", str(f), "--json",
+         "--root", str(tmp_path)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["clean"] is False
+    assert any(x["rule"] == "host-sync/cast" for x in doc["findings"])
+
+
+def test_cli_no_files_is_usage_error(tmp_path):
+    """A typo'd path must not let the CI gate go vacuously green."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.kubelint",
+         str(tmp_path / "no_such_dir")],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 2
+    assert "no Python files" in proc.stderr
+
+
+def test_package_init_relative_imports_resolve(tmp_path):
+    """`from .mod import f` inside pkg/__init__.py resolves against the
+    package itself, so kernels re-exported through __init__ stay in the
+    traced closure."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "kern.py").write_text("""
+def helper(x):
+    return float(x)
+""")
+    (pkg / "__init__.py").write_text("""
+import jax
+from .kern import helper
+
+@jax.jit
+def entry(x):
+    return helper(x)
+""")
+    res = run_lint([str(pkg)], root=str(tmp_path), rules=["host-sync"])
+    assert any(f.rule == "host-sync/cast" and "helper" in f.message
+               for f in res.findings), \
+        "\n".join(str(f) for f in res.findings)
+
+
+def test_cli_clean_exit_zero(tmp_path):
+    f = tmp_path / "ok.py"
+    f.write_text("import jax\n\n@jax.jit\ndef f(x):\n    return x\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.kubelint", str(f), "--json",
+         "--root", str(tmp_path)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout)["clean"] is True
+
+
+# ---------------------------------------------------------------------------
+# the real gate: the shipped tree is clean
+
+
+def test_kubetpu_tree_is_clean():
+    res = run_lint([os.path.join(REPO, "kubetpu")], root=REPO)
+    assert res.clean, (
+        "kubelint findings in kubetpu/ — fix them or add an inline "
+        "suppression with a reason:\n"
+        + "\n".join(str(f) for f in res.findings))
+
+
+def test_kubetpu_tree_suppressions_all_carry_reasons():
+    res = run_lint([os.path.join(REPO, "kubetpu")], root=REPO)
+    for f in res.suppressed:
+        assert f.reason.strip(), str(f)
+
+
+def test_detects_at_least_four_rule_families():
+    """Acceptance criterion: >= 4 rule families, each proven to fire by a
+    test above; this asserts the registry agrees."""
+    from tools.kubelint import RULE_FAMILIES
+    assert len(RULE_FAMILIES) >= 4

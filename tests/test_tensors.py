@@ -68,7 +68,9 @@ class TestSelectors:
         M = len(label_maps)
         kv = np.zeros((M, L), bool)
         key = np.zeros((M, K), bool)
-        num = np.full((M, K), np.nan, np.float32)
+        # +inf, not NaN: the NaN-free cluster-tensor contract
+        # (state/tensors.py; keeps jax_debug_nans meaningful)
+        num = np.full((M, K), np.inf, np.float32)
         for i, lm in enumerate(label_maps):
             for k, v in lm.items():
                 kv[i, table.kv.get((k, v))] = True

@@ -1,0 +1,15 @@
+"""kubelint: JAX-aware static analysis for the kubetpu hot path.
+
+Programmatic surface::
+
+    from tools.kubelint import run_lint
+    result = run_lint(["kubetpu/"])
+    assert result.clean, "\n".join(str(f) for f in result.findings)
+
+See README.md in this directory for the rule catalog and suppression
+syntax; ``python -m tools.kubelint kubetpu/`` is the CLI.
+"""
+
+from .core import Finding, LintResult, run_lint  # noqa: F401
+
+RULE_FAMILIES = ("host-sync", "recompile", "numeric", "purity")

@@ -1,0 +1,56 @@
+"""CLI: ``python -m tools.kubelint kubetpu/ [--json] [--rules fam,fam]``.
+
+Exit status: 0 when clean (all findings suppressed with reasons), 1 when
+unsuppressed findings remain, 2 on usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .core import run_lint
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="kubelint",
+        description="JAX-aware static analysis for the kubetpu hot path")
+    ap.add_argument("paths", nargs="+",
+                    help="files or directories to lint (e.g. kubetpu/)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output for CI")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule-id prefixes to restrict to "
+                         "(e.g. host-sync,numeric)")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed findings")
+    ap.add_argument("--root", default=".",
+                    help="package root for dotted module names")
+    args = ap.parse_args(argv)
+
+    rules = tuple(r.strip() for r in args.rules.split(",") if r.strip())
+    from .core import collect_files
+    if not collect_files(args.paths):
+        # a typo'd path must not let the CI gate go vacuously green
+        print("kubelint: no Python files found under: %s"
+              % " ".join(args.paths), file=sys.stderr)
+        return 2
+    result = run_lint(args.paths, root=args.root, rules=rules or None)
+
+    if args.json:
+        print(result.to_json())
+    else:
+        for f in result.findings:
+            print(f)
+        if args.show_suppressed:
+            for f in result.suppressed:
+                print(f)
+        n, s = len(result.findings), len(result.suppressed)
+        print("kubelint: %d finding%s (%d suppressed)"
+              % (n, "" if n == 1 else "s", s))
+    return 0 if result.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

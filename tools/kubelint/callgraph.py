@@ -1,0 +1,374 @@
+"""Traced-function discovery: which functions run under a JAX trace?
+
+Roots are every ``jax.jit`` / ``jax.vmap`` / ``jax.pmap`` decoration or
+call, plus callables handed to the ``jax.lax`` control-flow combinators
+(``scan``, ``while_loop``, ``cond``, ``fori_loop``, ``map``, ``switch``) —
+their bodies execute under the enclosing trace.  From those roots we close
+over intra-package call edges (plain names, ``from x import f`` names, and
+``alias.f`` attribute calls through import aliases), so a kernel like
+``ops.kernels.fit_filter`` is traced because ``models.programs.run_filters``
+(reached from the jitted ``filter_and_score``) calls it.
+
+The graph also records each jit root's *static* parameters
+(``static_argnames`` / ``static_argnums``), letting the host-sync rules
+treat e.g. ``residual_window`` in ``models/gang.py`` as a Python value, not
+a potential tracer.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import SourceModule
+
+# jax transforms whose function argument (or decorated function) is traced
+_TRANSFORMS = {
+    "jax.jit", "jax.pmap", "jax.vmap", "jax.grad", "jax.value_and_grad",
+    "jax.checkpoint", "jax.remat", "jax.custom_jvp", "jax.custom_vjp",
+    "jax.named_call",
+}
+# jax.lax combinators: map positional-arg indices that receive callables
+_COMBINATORS = {
+    "jax.lax.scan": (0,),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.cond": (1, 2, 3),
+    "jax.lax.switch": (1, 2, 3, 4, 5, 6, 7, 8),
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.map": (0,),
+    "jax.lax.associative_scan": (0,),
+    "jax.lax.custom_root": (1, 2, 3),
+}
+
+_JAX_MODULE_PREFIXES = ("jax",)
+
+
+class FunctionInfo:
+    def __init__(self, module: SourceModule, node: ast.AST, qualname: str):
+        self.module = module
+        self.node = node
+        self.qualname = qualname        # "mod.dotted:Outer.inner"
+        self.static_params: Set[str] = set()
+        self.is_root = False
+        self.callees: List["FunctionInfo"] = []
+        self.traced = False
+
+    @property
+    def name(self) -> str:
+        return getattr(self.node, "name", "<lambda>")
+
+
+class ModuleInfo:
+    def __init__(self, module: SourceModule):
+        self.module = module
+        # alias -> dotted module path ("jnp" -> "jax.numpy")
+        self.import_aliases: Dict[str, str] = {}
+        # local name -> (module dotted, original name)
+        self.from_imports: Dict[str, Tuple[str, str]] = {}
+        # top-level function name -> FunctionInfo
+        self.functions: Dict[str, FunctionInfo] = {}
+        # every FunctionInfo in the module incl. nested + lambdas, keyed by node id
+        self.by_node: Dict[int, FunctionInfo] = {}
+        # module-level assigned names (constants) — treated as static
+        self.module_consts: Set[str] = set()
+
+
+class CallGraph:
+    def __init__(self, modules: Sequence[SourceModule]):
+        self.mods: Dict[str, ModuleInfo] = {}
+        for m in modules:
+            self.mods[m.name] = self._scan_module(m)
+        self._link_and_close()
+
+    # -------------------------------------------------------------- scanning
+
+    def _scan_module(self, m: SourceModule) -> ModuleInfo:
+        mi = ModuleInfo(m)
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mi.import_aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                base = self._resolve_from(m, node)
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    mi.from_imports[a.asname or a.name] = (base, a.name)
+        for stmt in m.tree.body:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        mi.module_consts.add(t.id)
+            elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target,
+                                                                ast.Name):
+                mi.module_consts.add(stmt.target.id)
+        self._scan_functions(mi, m.tree.body, prefix="")
+        return mi
+
+    @staticmethod
+    def _resolve_from(module: SourceModule, node: ast.ImportFrom) -> str:
+        if not node.level:
+            return node.module or ""
+        parts = module.name.split(".")
+        # `from . import x` in a plain module drops 1 component (the module
+        # name), `from .. import x` two, etc.  A package __init__'s dotted
+        # name IS its package, so it drops one fewer.
+        drop = node.level - 1 if module.is_package else node.level
+        base = parts[:len(parts) - drop] if drop <= len(parts) else []
+        if node.module:
+            base = base + node.module.split(".")
+        return ".".join(base)
+
+    def _scan_functions(self, mi: ModuleInfo, body, prefix: str) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = "%s:%s%s" % (mi.module.name, prefix, stmt.name)
+                fi = FunctionInfo(mi.module, stmt, qual)
+                mi.by_node[id(stmt)] = fi
+                if not prefix:
+                    mi.functions[stmt.name] = fi
+                self._root_from_decorators(mi, fi)
+                self._scan_functions(mi, stmt.body,
+                                     prefix=prefix + stmt.name + ".")
+            elif isinstance(stmt, ast.ClassDef):
+                self._scan_functions(mi, stmt.body,
+                                     prefix=prefix + stmt.name + ".")
+
+    # ------------------------------------------------------- name resolution
+
+    def resolve_dotted(self, mi: ModuleInfo, expr: ast.AST) -> Optional[str]:
+        """Resolve a Name/Attribute chain to a dotted path through this
+        module's imports: ``jnp.floor`` -> "jax.numpy.floor",
+        ``functools.partial`` -> "functools.partial",
+        ``jit`` (from jax import jit) -> "jax.jit"."""
+        parts: List[str] = []
+        node = expr
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        head = node.id
+        parts.reverse()
+        if head in mi.import_aliases:
+            return ".".join([mi.import_aliases[head]] + parts)
+        if head in mi.from_imports:
+            base, orig = mi.from_imports[head]
+            return ".".join(([base + "." + orig] if base else [orig]) + parts)
+        return ".".join([head] + parts)
+
+    def _is_transform(self, mi: ModuleInfo, expr: ast.AST) -> bool:
+        d = self.resolve_dotted(mi, expr)
+        return d in _TRANSFORMS
+
+    def combinator_callable_slots(self, mi: ModuleInfo,
+                                  call: ast.Call) -> Tuple[int, ...]:
+        d = self.resolve_dotted(mi, call.func)
+        if d is None:
+            return ()
+        # accept both jax.lax.scan and lax.scan spellings resolved to
+        # jax.lax.scan via `from jax import lax`
+        if d in _COMBINATORS:
+            return _COMBINATORS[d]
+        return ()
+
+    # ------------------------------------------------------------ jit roots
+
+    def _root_from_decorators(self, mi: ModuleInfo, fi: FunctionInfo) -> None:
+        node = fi.node
+        for dec in getattr(node, "decorator_list", []):
+            target = dec
+            static_kw = None
+            if isinstance(dec, ast.Call):
+                fn_d = self.resolve_dotted(mi, dec.func)
+                if fn_d in ("functools.partial", "partial"):
+                    if not dec.args:
+                        continue
+                    target = dec.args[0]
+                    static_kw = dec.keywords
+                else:
+                    target = dec.func
+                    static_kw = dec.keywords
+            if self._is_transform(mi, target):
+                fi.is_root = True
+                if static_kw:
+                    fi.static_params |= self._static_names(node, static_kw)
+
+    @staticmethod
+    def _static_names(fn_node, keywords) -> Set[str]:
+        names: Set[str] = set()
+        args = getattr(fn_node, "args", None)
+        params = ([a.arg for a in args.posonlyargs + args.args]
+                  if args is not None else [])
+        for kw in keywords or []:
+            if kw.arg == "static_argnames":
+                v = kw.value
+                vals = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+                for e in vals:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                        names.add(e.value)
+            elif kw.arg == "static_argnums":
+                v = kw.value
+                vals = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+                for e in vals:
+                    if (isinstance(e, ast.Constant)
+                            and isinstance(e.value, int)
+                            and 0 <= e.value < len(params)):
+                        names.add(params[e.value])
+        return names
+
+    # --------------------------------------------------------- edges + close
+
+    def _function_scope_chain(self, mi: ModuleInfo,
+                              fi: FunctionInfo) -> List[FunctionInfo]:
+        """Enclosing FunctionInfos, innermost-out (for nested-def lookup)."""
+        chain = []
+        node = fi.node
+        for a in mi.module.ancestors(node):
+            info = mi.by_node.get(id(a))
+            if info is not None:
+                chain.append(info)
+        return chain
+
+    def _lookup_callee(self, mi: ModuleInfo, caller: FunctionInfo,
+                       func: ast.AST) -> Optional[FunctionInfo]:
+        if isinstance(func, ast.Name):
+            # nested defs of the caller (and its enclosing functions)
+            for scope in [caller] + self._function_scope_chain(mi, caller):
+                for stmt in ast.walk(scope.node):
+                    if (isinstance(stmt, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))
+                            and stmt.name == func.id
+                            and id(stmt) in mi.by_node):
+                        return mi.by_node[id(stmt)]
+            if func.id in mi.functions:
+                return mi.functions[func.id]
+            if func.id in mi.from_imports:
+                base, orig = mi.from_imports[func.id]
+                other = self.mods.get(base)
+                if other is not None:
+                    return other.functions.get(orig)
+            return None
+        if isinstance(func, ast.Attribute) and isinstance(func.value,
+                                                          ast.Name):
+            alias = func.value.id
+            target_mod = None
+            if alias in mi.import_aliases:
+                target_mod = self.mods.get(mi.import_aliases[alias])
+            elif alias in mi.from_imports:
+                base, orig = mi.from_imports[alias]
+                target_mod = self.mods.get((base + "." + orig) if base
+                                           else orig)
+            if target_mod is not None:
+                return target_mod.functions.get(func.attr)
+        return None
+
+    def _link_and_close(self) -> None:
+        roots: List[FunctionInfo] = []
+        for mi in self.mods.values():
+            for fi in list(mi.by_node.values()):
+                if fi.is_root:
+                    roots.append(fi)
+            # transform/combinator CALL sites anywhere in the module
+            for call in ast.walk(mi.module.tree):
+                if not isinstance(call, ast.Call):
+                    continue
+                slots: Tuple[int, ...] = ()
+                if self._is_transform(mi, call.func):
+                    slots = (0,)
+                else:
+                    slots = self.combinator_callable_slots(mi, call)
+                for s in slots:
+                    if s >= len(call.args):
+                        continue
+                    arg = call.args[s]
+                    if isinstance(arg, ast.Lambda):
+                        fi = mi.by_node.get(id(arg))
+                        if fi is None:
+                            fi = FunctionInfo(mi.module, arg,
+                                              mi.module.name + ":<lambda>")
+                            mi.by_node[id(arg)] = fi
+                        fi.is_root = True
+                        roots.append(fi)
+                    elif isinstance(arg, ast.Name):
+                        enclosing = mi.module.enclosing_function(call)
+                        caller = (mi.by_node.get(id(enclosing))
+                                  if enclosing is not None else None)
+                        target = None
+                        if caller is not None:
+                            target = self._lookup_callee(mi, caller, arg)
+                        if target is None:
+                            target = mi.functions.get(arg.id)
+                        if target is not None:
+                            target.is_root = True
+                            # call-form jit carries its static args too:
+                            # f = jax.jit(g, static_argnames=("n",))
+                            target.static_params |= self._static_names(
+                                target.node, call.keywords)
+                            roots.append(target)
+
+        # call edges
+        for mi in self.mods.values():
+            for fi in mi.by_node.values():
+                body = (fi.node.body if isinstance(fi.node.body, list)
+                        else [fi.node.body])
+                for stmt in body:
+                    for call in ast.walk(stmt):
+                        if not isinstance(call, ast.Call):
+                            continue
+                        # don't descend into nested defs twice: edges from a
+                        # nested def belong to the nested FunctionInfo; the
+                        # innermost-function check handles attribution
+                        enc = mi.module.enclosing_function(call)
+                        if enc is not fi.node:
+                            continue
+                        callee = self._lookup_callee(mi, fi, call.func)
+                        if callee is not None:
+                            fi.callees.append(callee)
+
+        # BFS closure
+        seen: Set[int] = set()
+        stack = list(dict.fromkeys(roots, None))
+        while stack:
+            fi = stack.pop()
+            if id(fi) in seen:
+                continue
+            seen.add(id(fi))
+            fi.traced = True
+            stack.extend(fi.callees)
+
+    # ------------------------------------------------------------ query API
+
+    def info_for(self, module: SourceModule,
+                 fn_node: ast.AST) -> Optional[FunctionInfo]:
+        mi = self.mods.get(module.name)
+        return mi.by_node.get(id(fn_node)) if mi else None
+
+    def is_traced_node(self, module: SourceModule, node: ast.AST) -> bool:
+        """True when ``node`` sits inside a function that executes under a
+        JAX trace (innermost enclosing function wins)."""
+        fn = module.enclosing_function(node)
+        if fn is None:
+            return False
+        fi = self.info_for(module, fn)
+        return bool(fi and fi.traced)
+
+    def traced_functions(self, module: SourceModule) -> List[FunctionInfo]:
+        mi = self.mods.get(module.name)
+        if mi is None:
+            return []
+        return [fi for fi in mi.by_node.values() if fi.traced]
+
+    def module_info(self, module: SourceModule) -> ModuleInfo:
+        return self.mods[module.name]
+
+    def is_kernel_module(self, module: SourceModule) -> bool:
+        """Kernel modules hold (or feed) jitted program code: anything under
+        an ops/ or models/ package, plus any module that defines a jit
+        root itself."""
+        parts = module.name.split(".")
+        if "ops" in parts or "models" in parts:
+            return True
+        mi = self.mods.get(module.name)
+        return bool(mi and any(fi.is_root for fi in mi.by_node.values()))

@@ -1,0 +1,248 @@
+"""kubelint core: source loading, suppression parsing, finding model, runner.
+
+kubelint is an AST-based static-analysis pass purpose-built for this
+codebase's correctness contract: every scheduler hot loop is a pure, jitted
+JAX program whose placements must bit-match the Go reference.  XLA will
+never check the invariants that contract rests on — no host syncs inside
+traced code, no silent recompilation, no f64 widening, no impure kernels —
+so kubelint checks them mechanically.  One module per rule family:
+
+    rules_host_sync   host-sync / tracer-leak rules      (host-sync/*)
+    rules_recompile   recompilation-hazard rules         (recompile/*)
+    rules_numeric     numeric-fidelity rules             (numeric/*)
+    rules_purity      kernel-purity rules                (purity/*)
+
+Inline suppression syntax (reason is REQUIRED):
+
+    x = float(w)  # kubelint: ignore[host-sync/cast] w is a static weight
+
+A suppression written on its own line covers the next source line instead.
+A suppression without a reason, or naming no rule id, is itself reported as
+``kubelint/bad-suppression`` (which cannot be suppressed).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+_SUPPRESS_RE = re.compile(r"#\s*kubelint:\s*ignore\[([^\]]*)\]\s*(.*)$")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    reason: str = ""
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "suppressed": self.suppressed, "reason": self.reason}
+
+    def __str__(self) -> str:
+        tag = " (suppressed: %s)" % self.reason if self.suppressed else ""
+        return "%s:%d:%d: [%s] %s%s" % (self.path, self.line, self.col,
+                                        self.rule, self.message, tag)
+
+
+@dataclasses.dataclass
+class Suppression:
+    line: int          # line the comment sits on
+    applies_to: int    # line whose findings it suppresses
+    rules: Tuple[str, ...]
+    reason: str
+
+
+class SourceModule:
+    """One parsed source file plus the lookup structures rules need."""
+
+    def __init__(self, path: str, name: str, src: str):
+        self.path = path
+        self.name = name            # dotted module name, e.g. kubetpu.ops.kernels
+        # package __init__ modules resolve `from .x import y` against
+        # themselves, not their parent (callgraph._resolve_from)
+        self.is_package = os.path.basename(path) == "__init__.py"
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src, filename=path)
+        self.parents: Dict[int, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[id(child)] = parent
+        self.suppressions: List[Suppression] = []
+        self.bad_suppressions: List[Finding] = []
+        self._parse_suppressions()
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self.parents.get(id(node))
+
+    def ancestors(self, node: ast.AST):
+        p = self.parent(node)
+        while p is not None:
+            yield p
+            p = self.parent(p)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        for a in self.ancestors(node):
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                return a
+        return None
+
+    def _parse_suppressions(self) -> None:
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            ids = tuple(s.strip() for s in m.group(1).split(",") if s.strip())
+            reason = m.group(2).strip()
+            code_before = line[:m.start()].strip()
+            applies_to = i if code_before else i + 1
+            if not ids or not reason:
+                self.bad_suppressions.append(Finding(
+                    rule="kubelint/bad-suppression", path=self.path,
+                    line=i, col=m.start() + 1,
+                    message="suppression must name at least one rule id and "
+                            "carry a reason: '# kubelint: ignore[rule-id] "
+                            "why this is safe'"))
+                continue
+            self.suppressions.append(Suppression(
+                line=i, applies_to=applies_to, rules=ids, reason=reason))
+
+    def suppression_for(self, rule: str, line: int) -> Optional[Suppression]:
+        for s in self.suppressions:
+            if s.applies_to == line and rule in s.rules:
+                return s
+        return None
+
+
+class LintContext:
+    """Shared cross-module state handed to every rule module."""
+
+    def __init__(self, modules: Sequence[SourceModule]):
+        self.modules = list(modules)
+        # built lazily by the runner so rule modules can assume presence
+        self.callgraph = None
+
+    def module_by_name(self, name: str) -> Optional[SourceModule]:
+        for m in self.modules:
+            if m.name == name:
+                return m
+        return None
+
+
+def _module_name(path: str, root: str) -> str:
+    rel = os.path.relpath(os.path.abspath(path), os.path.abspath(root))
+    if rel.startswith(".."):
+        rel = os.path.basename(path)
+    rel = rel[:-3] if rel.endswith(".py") else rel
+    parts = [p for p in rel.split(os.sep) if p not in ("", ".")]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) or os.path.basename(path)
+
+
+def collect_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in ("__pycache__",))
+                for f in sorted(filenames):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(dirpath, f))
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def load_modules(paths: Iterable[str], root: str = ".") -> List[SourceModule]:
+    mods = []
+    for f in collect_files(paths):
+        with open(f, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        mods.append(SourceModule(f, _module_name(f, root), src))
+    return mods
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]          # unsuppressed (includes bad-suppression)
+    suppressed: List[Finding]
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"clean": self.clean,
+             "findings": [f.to_json() for f in self.findings],
+             "suppressed": [f.to_json() for f in self.suppressed]},
+            indent=2, sort_keys=True)
+
+
+def run_lint(paths: Sequence[str], root: str = ".",
+             rules: Optional[Sequence[str]] = None) -> LintResult:
+    """Lint every .py file under ``paths``.  ``rules``: optional rule-id
+    prefixes to restrict to (e.g. ["host-sync"])."""
+    from . import callgraph as cg
+    from . import (rules_host_sync, rules_numeric, rules_purity,
+                   rules_recompile)
+
+    modules = load_modules(paths, root=root)
+    ctx = LintContext(modules)
+    ctx.callgraph = cg.CallGraph(modules)
+
+    raw: List[Finding] = []
+    for mod in modules:
+        raw.extend(mod.bad_suppressions)
+        for rule_mod in (rules_host_sync, rules_recompile, rules_numeric,
+                         rules_purity):
+            raw.extend(rule_mod.check(mod, ctx))
+
+    if rules:
+        raw = [f for f in raw
+               if f.rule == "kubelint/bad-suppression"
+               or any(f.rule.startswith(r) for r in rules)]
+
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+    used = set()
+    for f in sorted(raw, key=lambda f: (f.path, f.line, f.col, f.rule)):
+        mod = next((m for m in modules if m.path == f.path), None)
+        sup = (mod.suppression_for(f.rule, f.line)
+               if mod is not None and f.rule != "kubelint/bad-suppression"
+               else None)
+        if sup is not None:
+            f.suppressed, f.reason = True, sup.reason
+            used.add((f.path, id(sup)))
+            suppressed.append(f)
+        else:
+            findings.append(f)
+    if not rules:
+        # a suppression matching no finding is stale: the exempted code was
+        # fixed or moved, and the comment now falsely documents an
+        # exemption.  (Skipped under a --rules filter, which hides the
+        # findings other families' suppressions legitimately cover.)
+        for mod in modules:
+            for sup in mod.suppressions:
+                if (mod.path, id(sup)) not in used:
+                    findings.append(Finding(
+                        rule="kubelint/unused-suppression", path=mod.path,
+                        line=sup.line, col=1,
+                        message="suppression for %s matches no finding — "
+                                "remove the stale comment"
+                                % ", ".join(sup.rules)))
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return LintResult(findings=findings, suppressed=suppressed)

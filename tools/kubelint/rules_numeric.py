@@ -1,0 +1,141 @@
+"""numeric/* — numeric-fidelity rules.
+
+The placement contract is bit-parity with the Go reference's int64
+arithmetic, reproduced in f32 with explicit remainder-corrected division
+(``ops/kernels.py:_idiv``).  Two classes of silent drift:
+
+  * f64 widening: an accidental float64 constant/dtype doubles HBM and
+    splits programs across backends (TPU demotes f64 with a warning, CPU
+    keeps it — scores then diverge between test and serving platforms).
+  * fast-math division: XLA lowers ``x / b`` to ``x * (1/b)``; for exact
+    integer-valued f32 operands the product can land one ulp low, so
+    ``floor(a / b)`` computes e.g. ``floor(1.9999999) = 1`` where Go's
+    int64 division gives 2.  ``_idiv`` exists precisely for this (see its
+    docstring) — score arithmetic must use it.
+
+Rules:
+
+  numeric/f64          float64 dtype reference (jnp.float64 / np.float64 /
+                       dtype="float64" / astype(float)) in a kernel module
+                       or traced function.
+  numeric/x64-enable   jax_enable_x64 flipped anywhere in the linted tree.
+  numeric/floor-div    jnp.floor(a / b) — truncating a raw division
+                       without remainder correction: the exact _idiv trap.
+  numeric/score-div    bare `/` or `//` on score-scale values (an operand
+                       names MAX_NODE_SCORE or a score/raw-score variable)
+                       inside a traced function — use _idiv/_itrunc unless
+                       the reference itself does float division here.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from .core import Finding, SourceModule
+
+_F64_ATTRS = {"jax.numpy.float64", "numpy.float64", "jax.numpy.complex128",
+              "numpy.complex128"}
+_SCORE_NAME_RE = re.compile(r"(^|_)(scores?|raw)($|_)|^MAX_NODE_SCORE$")
+
+
+def _names_in(expr: ast.AST):
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name):
+            yield n.id
+        elif isinstance(n, ast.Attribute):
+            yield n.attr  # K.MAX_NODE_SCORE, res.scores, ...
+
+
+def check(module: SourceModule, ctx) -> List[Finding]:
+    cg = ctx.callgraph
+    mi = cg.module_info(module)
+    out: List[Finding] = []
+    kernel_module = cg.is_kernel_module(module)
+
+    for node in ast.walk(module.tree):
+        traced = cg.is_traced_node(module, node)
+
+        # ---- f64 references ------------------------------------------
+        if isinstance(node, ast.Attribute) and (kernel_module or traced):
+            dotted = cg.resolve_dotted(mi, node)
+            if dotted in _F64_ATTRS:
+                out.append(Finding(
+                    "numeric/f64", module.path, node.lineno,
+                    node.col_offset + 1,
+                    "%s in %s — f64 silently widens score math and splits "
+                    "TPU/CPU behavior; the placement contract is f32 with "
+                    "explicit integer emulation" % (
+                        dotted.replace("jax.numpy", "jnp").replace(
+                            "numpy", "np"),
+                        "a traced function" if traced else "a kernel module")))
+        if isinstance(node, ast.Constant) and node.value in ("float64",
+                                                            "f8") \
+                and (kernel_module or traced):
+            parent = module.parent(node)
+            in_dtype = (isinstance(parent, ast.keyword)
+                        and parent.arg == "dtype") or \
+                       (isinstance(parent, ast.Call)
+                        and isinstance(parent.func, ast.Attribute)
+                        and parent.func.attr == "astype")
+            if in_dtype:
+                out.append(Finding(
+                    "numeric/f64", module.path, node.lineno,
+                    node.col_offset + 1,
+                    'dtype "float64" in a kernel module — use jnp.float32'))
+        if isinstance(node, ast.Call) and (kernel_module or traced):
+            # x.astype(float): Python float IS float64
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "astype" and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id == "float"):
+                out.append(Finding(
+                    "numeric/f64", module.path, node.lineno,
+                    node.col_offset + 1,
+                    ".astype(float) — Python float means float64; use "
+                    "jnp.float32"))
+
+        # ---- x64 enable ----------------------------------------------
+        if isinstance(node, ast.Call):
+            dotted = cg.resolve_dotted(mi, node.func) or ""
+            if dotted.endswith("config.update") and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    node.args[0].value == "jax_enable_x64":
+                out.append(Finding(
+                    "numeric/x64-enable", module.path, node.lineno,
+                    node.col_offset + 1,
+                    "jax_enable_x64 flipped here — the whole scoring "
+                    "pipeline is calibrated for f32 (ops/kernels.py "
+                    "module docstring); never enable x64 in-process"))
+
+        # ---- floor of a raw division ---------------------------------
+        if isinstance(node, ast.Call) and traced:
+            dotted = cg.resolve_dotted(mi, node.func)
+            if dotted in ("jax.numpy.floor", "numpy.floor") and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.BinOp) and isinstance(
+                        arg.op, (ast.Div, ast.FloorDiv)):
+                    out.append(Finding(
+                        "numeric/floor-div", module.path, node.lineno,
+                        node.col_offset + 1,
+                        "jnp.floor(a / b) without remainder correction — "
+                        "XLA fast-math computes a * (1/b), which can land "
+                        "one ulp low and floor to n-1 (the _idiv trap, "
+                        "ops/kernels.py:_idiv); use _idiv"))
+
+        # ---- bare division on score-scale tensors --------------------
+        if isinstance(node, ast.BinOp) and traced and isinstance(
+                node.op, (ast.Div, ast.FloorDiv)):
+            if any(_SCORE_NAME_RE.search(n)
+                   for n in _names_in(node.left)) or \
+               any(_SCORE_NAME_RE.search(n)
+                   for n in _names_in(node.right)):
+                out.append(Finding(
+                    "numeric/score-div", module.path, node.lineno,
+                    node.col_offset + 1,
+                    "bare `/` on score-scale values inside a traced "
+                    "function — Go int64 score division must go through "
+                    "_idiv/_itrunc (fast-math trap); suppress only where "
+                    "the reference itself does float division"))
+    return out
