@@ -201,7 +201,11 @@ def mode_summary(mode, best, first, outcomes, sched, stats):
     scheduled = sum(1 for o in outcomes if o.node)
     d = {"e2e_best_s": round(best, 3),
          "first_run_s": round(first, 3),
-         "compile_s": round(first - best, 1),
+         # first-run-minus-best is only a compile ESTIMATE; with the
+         # persistent XLA cache the first run can be the fastest (every
+         # compile is a cache load) and the raw subtraction went negative
+         # (BENCH_r05 chain_on: -0.3) — clamp at zero
+         "compile_s": round(max(first - best, 0.0), 1),
          "scheduled": scheduled}
     d.update(stats or {})
     if scheduled < len(outcomes):
@@ -314,20 +318,34 @@ def preemption_case(n_nodes=500, fillers=2000, high_prio=256):
     """Preemption under load (VERDICT r4 #9): the cluster is packed with
     low-priority fillers (4 x 900m per 4-cpu node), then high-priority
     600m pods arrive — every placement must select victims through the
-    PostFilter preemption pipeline (eligibility, batched what-if,
-    PDB-ordered reprieve, pickOne)."""
+    PostFilter preemption WAVE (eligibility, one batched [B, C, K]
+    what-if per cycle, contention auction, ranked commit).  Warm
+    best-of-2 like the other cases (attempt 0 pays the compiles), with
+    the per-attempt cycle count and device-wait/host split reported."""
     from kubetpu.harness.perf import Workload, run_workload
-    t0 = time.time()
-    items = run_workload(Workload(
-        name="PreemptionBench", num_nodes=n_nodes, num_init_pods=fillers,
-        num_pods_to_schedule=high_prio, preemption=True, batch_size=1024,
-        timeout_s=420))
-    dt = time.time() - t0
-    thr = next(it.data for it in items
-               if it.labels.get("Metric") == "SchedulingThroughput")
-    return {"nodes": n_nodes, "fillers": fillers, "high_prio": high_prio,
-            "e2e_s": round(dt, 1),
-            "preempting_pods_per_sec": thr}
+    best = None
+    for attempt in range(2):
+        t0 = time.time()
+        items = run_workload(Workload(
+            name="PreemptionBench", num_nodes=n_nodes,
+            num_init_pods=fillers, num_pods_to_schedule=high_prio,
+            preemption=True, batch_size=1024, timeout_s=420))
+        dt = time.time() - t0
+        thr = next(it.data for it in items
+                   if it.labels.get("Metric") == "SchedulingThroughput")
+        stats = next((it.data for it in items
+                      if it.labels.get("Metric") == "SchedulerStats"), {})
+        cur = {"nodes": n_nodes, "fillers": fillers, "high_prio": high_prio,
+               "e2e_s": round(dt, 1),
+               "first_attempt": attempt == 0,
+               "cycles": int(stats.get("Cycles", 0)),
+               "device_wait_s": stats.get("DeviceWaitS", 0.0),
+               "host_share": stats.get("HostShare", 0.0),
+               "preempting_pods_per_sec": thr}
+        if (best is None or thr.get("Average", 0.0)
+                > best["preempting_pods_per_sec"].get("Average", 0.0)):
+            best = cur
+    return best
 
 
 def warm_restart_case(n_nodes=1000, existing_per_node=2, wave=1024,

@@ -283,19 +283,34 @@ def run_workload(w: Workload, verbose: bool = False) -> List[DataItem]:
                         f"{w.name}: init pods did not schedule "
                         f"({coll.bound_count()}/{w.num_init_pods})")
         # phase 2: measured pods
+        device_wait0 = sched.device_wait_s
+        cycles0 = sched.cycle_count
+        t_measured = time.time()
         for i in range(w.num_pods_to_schedule):
             store.add(_make_pod(w, i, "measured", store))
         coll = ThroughputCollector(store, "measured")
         done = coll.run_until(w.num_pods_to_schedule,
                               timeout=w.timeout_s)
         sched.wait_for_inflight_binds()
+        elapsed = time.time() - t_measured
         scheduled = coll.bound_count()
         if verbose:
             print(f"  {w.name}: {scheduled}/{w.num_pods_to_schedule} "
                   f"scheduled", flush=True)
+        device_wait = sched.device_wait_s - device_wait0
         items = [
             DataItem(data=_stats(coll.samples), unit="pods/s",
                      labels={"Name": w.name, "Metric": "SchedulingThroughput"}),
+            # measured-phase scheduler internals (same split bench.py
+            # reports for its direct-drive cases): committed cycles, wall
+            # time spent blocked on the per-cycle packed readback, and the
+            # host share of the measured phase
+            DataItem(data={"Cycles": float(sched.cycle_count - cycles0),
+                           "DeviceWaitS": round(device_wait, 3),
+                           "HostShare": round(
+                               1.0 - device_wait / max(elapsed, 1e-9), 3)},
+                     unit="mixed",
+                     labels={"Name": w.name, "Metric": "SchedulerStats"}),
         ]
         for metric, hist in (
                 ("scheduling_algorithm_duration_seconds",

@@ -729,7 +729,7 @@ def _schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
     unresolvable = out["unres"]
     # the preemption gate must see HOST-filter failures as resolvable
     # (nodesWherePreemptionMightHelp counts them;
-    # preemption._nodes_where_preemption_might_help re-checks them), so
+    # preemption.Preemptor._wave_candidates re-checks them), so
     # host_ok is deliberately NOT part of this node-exclusion mask
     base_nodes = cluster.node_valid[None, :] & batch.valid[:, None]
     all_unres = jnp.all(unresolvable | out["feas0"] | ~base_nodes, axis=1)

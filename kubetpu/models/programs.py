@@ -282,6 +282,83 @@ def filter_verdicts(cluster, batch, cfg: ProgramConfig, host_ok=None):
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
+def whatif_static_ok(cluster, batch, cfg: ProgramConfig):
+    """Per-(pod, node) verdict of every filter EXCEPT NodeResourcesFit —
+    the victim-removal-invariant half of the preemption what-if.  Removing
+    victims only perturbs the resource channels (requested/pod-count; the
+    serial what-if never restores ports either) and, for term-carrying
+    pods, the topology one-hots; callers route term-carrying pods to the
+    per-pod reprieve instead (see preemption.py), so for wave pods this
+    verdict is constant across the whole reprieve scan and one [B, N]
+    pass covers every reprieve step of every candidate.  cfg must already
+    have the droppable topology filters removed."""
+    from .batch import densify_for
+    batch = densify_for(cluster, batch)
+    feasible, _, _ = run_filters(cluster, batch, cfg,
+                                 skip=("NodeResourcesFit",))
+    return feasible
+
+
+@jax.jit
+def whatif_wave(cluster, static_ok, wave_req, cand_rows, cand_valid,
+                nom_add, tab_req, tab_valid, cand_idx):
+    """Wave-batched selectVictimsOnNode (generic_scheduler.go:949) for a
+    whole cycle's failed pods at once — the [B, C, K] axis of the
+    preemption wave (preemption.py preempt_wave).  All shape axes are
+    pow2-bucketed by the caller (pow2_bucket) so repeated waves of similar
+    size hit one compiled program.
+
+    Victim tensors arrive as a compact per-(priority, node) TABLE plus
+    per-(pod, candidate) indices into it — same-priority preemptors share
+    victim rows, so the host->device transfer is O(S * K) instead of
+    O(B * C * K) (the [B, C, K, R] expansion happens on device, in HBM).
+
+    static_ok [B, N]      all non-fit filter verdicts (whatif_static_ok)
+    wave_req  [B, R]      preemptor resource request channels
+    cand_rows [B, C]      candidate node rows per pod (-1 pad)
+    cand_valid [B, C]     real (pod, candidate) pairs
+    nom_add   [B, C, R]   nominated-pod requests reserved on each candidate
+                          (equal/higher priority, self excluded — the
+                          addNominatedPods overlay, :594)
+    tab_req   [S, K, R]   victim resources per table row, reprieve order
+    tab_valid [S, K]      real victim slots per table row
+    cand_idx  [B, C]      table row per (pod, candidate) (0 pad, masked by
+                          cand_valid)
+
+    Returns packed [B, C, K+1] bool: [..., 0] = pod fits with every victim
+    removed (fits0); [..., 1 + k] = victim k was reprieved (stays)."""
+    import jax.numpy as jnp
+
+    rows = jnp.clip(cand_rows, 0)
+    sok = jnp.take_along_axis(static_ok, rows, axis=1) & cand_valid  # [B, C]
+    vic_req = jnp.take(tab_req, cand_idx, axis=0)           # [B, C, K, R]
+    vic_valid = (jnp.take(tab_valid, cand_idx, axis=0)
+                 & cand_valid[:, :, None])                  # [B, C, K]
+    rm_req = jnp.sum(vic_req * vic_valid[..., None].astype(vic_req.dtype),
+                     axis=2)                                # [B, C, R]
+    free_base = jnp.take(cluster.allocatable - cluster.requested, rows,
+                         axis=0)                            # [B, C, R]
+    breq = jnp.broadcast_to(wave_req[:, None, :], free_base.shape)
+    free = free_base - nom_add + rm_req
+    fits0 = K.fit_rows(breq, free) & sok
+
+    def step(carry, xs):
+        free, ok = carry
+        vreq, vvalid = xs                                   # [B,C,R],[B,C]
+        exists = vvalid & ok
+        try_free = free - vreq * exists[..., None].astype(free.dtype)
+        fit = K.fit_rows(breq, try_free) & sok & exists
+        free = jnp.where(fit[..., None], try_free, free)
+        return (free, ok), fit
+
+    (_, _), reprieved = jax.lax.scan(
+        step, (free, fits0),
+        (jnp.moveaxis(vic_req, 2, 0), jnp.moveaxis(vic_valid, 2, 0)))
+    return jnp.concatenate(
+        [fits0[:, :, None], jnp.moveaxis(reprieved, 0, -1)], axis=2)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
 def filter_and_score(cluster, batch, cfg: ProgramConfig,
                      host_ok=None) -> FilterScoreResult:
     from .batch import densify_for

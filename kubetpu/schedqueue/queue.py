@@ -219,12 +219,28 @@ class SchedulingQueue(PodNominator):
                   timeout: Optional[float] = None) -> List[QueuedPodInfo]:
         """TPU extension: drain up to max_batch ready pods in queue order for
         one device batch (the reference pops strictly one, scheduler.go:510;
-        batching is our throughput lever — SURVEY.md §7)."""
+        batching is our throughput lever — SURVEY.md §7).
+
+        When a BLOCKING pop wakes on the first pod of an arriving burst, a
+        short gather window lets the rest of the burst land before the
+        drain: waking instantly mid-burst splits one arrival wave into
+        arbitrary-sized cycles, which costs an extra serialized device
+        cycle AND churns the pow2 pod-axis bucket (a 196/60 split compiles
+        two programs where a 256-pod cycle reuses one).  Non-blocking pops
+        (timeout == 0) never wait — test/drain semantics are unchanged."""
         out: List[QueuedPodInfo] = []
         first = self.pop(timeout=timeout)
         if first is None:
             return out
         out.append(first)
+        if (timeout is None or timeout > 0) and len(out) < max_batch:
+            gather = 0.02 if timeout is None else min(0.02, timeout)
+            deadline = time.time() + gather
+            while time.time() < deadline:
+                with self._cond:
+                    if len(self.active_q) >= max_batch - len(out):
+                        break   # a full batch already landed
+                time.sleep(0.002)
         with self._cond:
             while len(out) < max_batch and len(self.active_q) > 0:
                 qp = self.active_q.pop()

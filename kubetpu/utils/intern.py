@@ -42,9 +42,12 @@ class Vocab:
             self._keys.append(key)
         return i
 
-    def get(self, key: Hashable) -> int:
-        """-1 if unknown (unknown => can never match anything in-cluster)."""
-        return self._ids.get(key, -1)
+    def get(self, key: Hashable, default: int = -1) -> int:
+        """default (-1) if unknown (unknown => can never match anything
+        in-cluster).  The explicit default keeps dict-style call sites —
+        e.g. table.rname.get(name, -1) for a victim carrying an
+        unregistered extended resource — from raising."""
+        return self._ids.get(key, default)
 
     def key(self, i: int) -> Hashable:
         return self._keys[i]

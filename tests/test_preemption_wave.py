@@ -1,0 +1,263 @@
+"""Wave-batched preemption (kubetpu/preemption.py preempt_wave): one
+[B, C, K] what-if serves every preemption-eligible FitError of a cycle.
+
+Covers:
+  * golden serial-vs-wave equivalence — a contention-free scenario where
+    the batched wave must pick bit-identical victims and nominations to
+    the serial per-pod path (pods arriving one cycle apart);
+  * cross-pod contention — overlapping victim sets on one node: exactly
+    one preemptor wins the node, the loser is re-waved or fails cleanly,
+    and no victim is ever deleted twice;
+  * regression — a victim carrying an extended resource no node ever
+    registered must not break victim tensorization;
+  * compile-count smoke — two same-bucket waves compile the wave what-if
+    exactly once (pow2 bucketing contract, utils/sanitize.py watchdog).
+"""
+import time
+
+from kubetpu.api import types as api
+from kubetpu.client.store import ClusterStore
+from kubetpu.harness import hollow
+from kubetpu.scheduler import Scheduler
+
+
+def add_victim(store, node_name, name, cpu=900, prio=0):
+    p = hollow.make_pod(name, cpu_milli=cpu, priority=prio)
+    p.spec.node_name = node_name
+    store.add(p)
+    return p
+
+
+def spy_deletes(store):
+    """Instrument store.delete; returns the list of deleted pod names in
+    call order (duplicates included — that is the point)."""
+    deleted = []
+    orig = store.delete
+
+    def spy(obj, *a, **kw):
+        deleted.append(obj.metadata.name)
+        return orig(obj, *a, **kw)
+
+    store.delete = spy
+    return deleted
+
+
+def retry(sched, tries=12):
+    out = []
+    for _ in range(tries):
+        sched.queue.flush_backoff_completed()
+        sched.queue.flush_unschedulable_leftover()
+        out.extend(sched.schedule_pending(timeout=0.0))
+        if not len(sched.queue):
+            break
+        time.sleep(0.5)
+    return out
+
+
+def _three_node_world():
+    """Three 2000m nodes, each carrying a prio-5 victim and one uniquely
+    cheap victim (prio 1/2/3) — pick_one's lowest-max-victim-priority rule
+    gives every preemptor a distinct best node, so wave and serial must
+    agree exactly."""
+    store = ClusterStore()
+    for i in range(3):
+        store.add(hollow.make_node(f"node-{i}", cpu_milli=2000))
+        add_victim(store, f"node-{i}", f"keep-{i}", cpu=900, prio=5)
+        add_victim(store, f"node-{i}", f"cheap-{i}", cpu=900, prio=i + 1)
+    return store
+
+
+def _preemptors(n):
+    # 1100m: infeasible while both victims run (free 200m), feasible after
+    # evicting exactly the cheap victim (free 1100m)
+    return [hollow.make_pod(f"high-{i}", cpu_milli=1100, priority=100)
+            for i in range(n)]
+
+
+def _nominations(store, pods):
+    return {p.metadata.name:
+            store.get_pod("default", p.metadata.name).status.nominated_node_name
+            for p in pods}
+
+
+def test_wave_matches_serial_golden():
+    """The batched wave must pick the same victims and the same nominated
+    nodes as the serial path (one failed pod per cycle) picks."""
+    # serial: pods arrive one cycle apart — each preemption is a 1-pod wave
+    store_s = _three_node_world()
+    sched_s = Scheduler(store_s, async_binding=False)
+    deleted_s = spy_deletes(store_s)
+    pods_s = _preemptors(3)
+    for p in pods_s:
+        store_s.add(p)
+        out = sched_s.schedule_pending(timeout=0.0)
+        assert out and out[0].err is not None
+    nom_s = _nominations(store_s, pods_s)
+
+    # wave: all three arrive in ONE batch — one preempt_wave call
+    store_w = _three_node_world()
+    sched_w = Scheduler(store_w, async_binding=False)
+    deleted_w = spy_deletes(store_w)
+    pods_w = _preemptors(3)
+    for p in pods_w:
+        store_w.add(p)
+    out = sched_w.schedule_pending(timeout=0.0)
+    assert len(out) == 3 and all(o.err is not None for o in out)
+    nom_w = _nominations(store_w, pods_w)
+
+    assert nom_s == nom_w == {"high-0": "node-0", "high-1": "node-1",
+                              "high-2": "node-2"}
+    # bit-identical victim sets, serial order included
+    assert deleted_s == deleted_w == ["cheap-0", "cheap-1", "cheap-2"]
+    sched_s.close()
+    sched_w.close()
+
+
+def test_wave_contention_one_winner_no_double_delete():
+    """Two preemptors whose only viable victims overlap on one node: the
+    higher-ranked one wins the node, the loser is re-waved against the
+    updated eviction overlay (and here finds the node now big enough to
+    not need preemption at all — it fails cleanly and binds next cycle),
+    and no victim is deleted twice."""
+    store = ClusterStore()
+    store.add(hollow.make_node("n1", cpu_milli=4000))
+    victims = [add_victim(store, "n1", f"filler-{i}", cpu=900, prio=0)
+               for i in range(4)]
+    sched = Scheduler(store, async_binding=False)
+    deleted = spy_deletes(store)
+    for i in range(2):
+        store.add(hollow.make_pod(f"high-{i}", cpu_milli=600, priority=100))
+    out = sched.schedule_pending(timeout=0.0)
+    assert len(out) == 2 and all(o.err is not None for o in out)
+
+    noms = [store.get_pod("default", f"high-{i}").status.nominated_node_name
+            for i in range(2)]
+    # exactly one wins the node
+    assert sorted(noms) == ["", "n1"]
+    # no victim double-deleted; one eviction (900m) frees enough for both
+    assert len(deleted) == len(set(deleted)) == 1
+    # the loser is not starved: with the victim gone (and the winner's
+    # nomination reserved), both bind on retry
+    retry(sched)
+    for i in range(2):
+        assert store.get_pod("default", f"high-{i}").spec.node_name == "n1"
+    assert len(deleted) == 1   # retries deleted nothing further
+    sched.close()
+
+
+def test_wave_contention_loser_fails_cleanly_when_node_too_small():
+    """Overlap variant where the node cannot host both preemptors: the
+    loser must fail cleanly (no nomination, no extra eviction)."""
+    store = ClusterStore()
+    store.add(hollow.make_node("n1", cpu_milli=2000))
+    add_victim(store, "n1", "v-0", cpu=900, prio=0)
+    add_victim(store, "n1", "v-1", cpu=900, prio=0)
+    sched = Scheduler(store, async_binding=False)
+    deleted = spy_deletes(store)
+    for i in range(2):
+        store.add(hollow.make_pod(f"high-{i}", cpu_milli=1100, priority=100))
+    out = sched.schedule_pending(timeout=0.0)
+    assert len(out) == 2 and all(o.err is not None for o in out)
+    noms = [store.get_pod("default", f"high-{i}").status.nominated_node_name
+            for i in range(2)]
+    assert sorted(noms) == ["", "n1"]
+    assert len(deleted) == len(set(deleted)) == 1
+    sched.close()
+
+
+def test_wave_pdb_partition_consumes_budget_in_snapshot_order():
+    """The per-PDB disruption budget must be consumed in ni.pods snapshot
+    order (filterPodsWithPDBViolation :1118), exactly like the serial
+    path — feeding the priority-sorted victim list instead would mark the
+    wrong victim violating and flip the reprieve order.
+
+    Node: victims A(prio 0) then B(prio 5) in snapshot order, one PDB
+    with disruptions_allowed=1 matching both.  Snapshot-order budgeting
+    makes A non-violating and B violating, so reprieve order is [B, A]:
+    B (first) is reprieved, A is evicted.  Priority-order budgeting would
+    evict B instead."""
+    store = ClusterStore()
+    store.add(hollow.make_node("n1", cpu_milli=2000))
+    for name, prio in (("victim-a", 0), ("victim-b", 5)):
+        v = add_victim(store, "n1", name, cpu=900, prio=prio)
+        v.metadata.labels["app"] = "guarded"
+        store.update(v)
+    store.add(api.PodDisruptionBudget(
+        metadata=api.ObjectMeta(name="pdb"),
+        selector=api.LabelSelector(match_labels={"app": "guarded"}),
+        disruptions_allowed=1))
+    sched = Scheduler(store, async_binding=False)
+    store.add(hollow.make_pod("high", cpu_milli=1100, priority=100))
+    out = sched.schedule_pending(timeout=0.0)
+    assert out[0].err is not None
+    assert (store.get_pod("default", "high").status.nominated_node_name
+            == "n1")
+    assert store.get_pod("default", "victim-a") is None      # evicted
+    assert store.get_pod("default", "victim-b") is not None  # reprieved
+    sched.close()
+
+
+def test_victim_with_unknown_extended_resource():
+    """Regression (victim tensorization): a victim requesting an extended
+    resource that no node registers (rname vocab miss -> channel -1) must
+    be skipped, not crash the wave."""
+    store = ClusterStore()
+    store.add(hollow.make_node("n1", cpu_milli=1000))
+    victim = hollow.make_pod("weird-victim", cpu_milli=900, priority=0)
+    victim.spec.containers[0].resources.requests["example.com/weird"] = "3"
+    victim.spec.node_name = "n1"
+    store.add(victim)
+    sched = Scheduler(store, async_binding=False)
+    store.add(hollow.make_pod("high", cpu_milli=500, priority=100))
+    out = sched.schedule_pending(timeout=0.0)
+    assert out[0].err is not None
+    assert (store.get_pod("default", "high").status.nominated_node_name
+            == "n1")
+    assert store.get_pod("default", "weird-victim") is None  # evicted
+    sched.close()
+
+
+def test_wave_compiles_once_across_same_bucket_waves():
+    """Compile-count smoke (pow2 bucketing contract): two waves with the
+    same [B, C, K] buckets must compile the wave what-if exactly once —
+    the second wave is a pure jit-cache hit."""
+    from kubetpu.apis.config import (KubeSchedulerConfiguration,
+                                     KubeSchedulerProfile)
+    from kubetpu.utils.sanitize import sanitized
+
+    store = ClusterStore()
+    for pool in ("a", "b"):
+        for i in range(2):
+            store.add(hollow.make_node(f"n-{pool}{i}", cpu_milli=1000,
+                                       labels={"pool": pool}))
+            add_victim(store, f"n-{pool}{i}", f"v-{pool}{i}", cpu=900)
+
+    def preemptor(name, pool):
+        p = hollow.make_pod(name, cpu_milli=600, priority=100)
+        p.spec.node_selector = {"pool": pool}
+        return p
+
+    with sanitized() as wd:
+        sched = Scheduler(store, config=KubeSchedulerConfiguration(
+            profiles=[KubeSchedulerProfile()], prewarm=False),
+            async_binding=False)
+        store.add(preemptor("high-a", "a"))
+        out = sched.schedule_pending(timeout=0.0)
+        assert out[0].err is not None
+        assert store.get_pod(
+            "default", "high-a").status.nominated_node_name.startswith("n-a")
+
+        def wave_compiles():
+            return sum(c for (name, _), c in wd.counts.items()
+                       if "whatif_wave" in name)
+
+        assert wave_compiles() == 1
+
+        store.add(preemptor("high-b", "b"))
+        out = sched.schedule_pending(timeout=0.0)
+        assert out and out[-1].err is not None
+        assert store.get_pod(
+            "default", "high-b").status.nominated_node_name.startswith("n-b")
+        assert wave_compiles() == 1, "second same-bucket wave recompiled"
+        wd.assert_no_recompilation()
+        sched.close()

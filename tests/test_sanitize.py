@@ -53,6 +53,11 @@ def test_scheduling_cycle_under_sanitizer(mode, monkeypatch):
     monkeypatch.setenv(sanitize.ENV_FLAG, "1")
     assert sanitize_enabled()
     owned = sanitize.current_watchdog() is None
+    # earlier tests in the same process may already have compiled this
+    # scenario's exact (program, shape) set — start cold so the
+    # compile_count() > 0 assertion below measures THIS test's work
+    import jax
+    jax.clear_caches()
     with sanitized() as wd:
         store, sched = make_sched(mode=mode)
         outcomes = run_cycles(store, sched, waves=2)
