@@ -1,11 +1,30 @@
 # Developer entrypoints.  `make lint` is the static-analysis gate builders
 # run by default; `make test` is the tier-1 suite (which embeds the same
-# lint gate via tests/test_kubelint.py).
+# lint gate via tests/test_kubelint.py).  `make help` lists everything.
 
-.PHONY: lint test sanitize-test bench
+.PHONY: help lint lock-graph test sanitize-test race-test bench
+
+help:
+	@echo "kubetpu targets:"
+	@echo "  make lint           kubelint over kubetpu/ (all 5 rule families:"
+	@echo "                      host-sync, recompile, numeric, purity,"
+	@echo "                      concurrency), JSON CI mode, nonzero on findings"
+	@echo "  make lock-graph     print the lock-ownership map + acquisition-"
+	@echo "                      order table (README 'Concurrency model')"
+	@echo "  make test           tier-1 suite (JAX on CPU, slow tests skipped)"
+	@echo "  make sanitize-test  full cycles under KUBETPU_SANITIZE=1"
+	@echo "                      (debug_nans, rank-promotion, compile watchdog)"
+	@echo "  make race-test      8-thread stress + seeded-violation tests under"
+	@echo "                      KUBETPU_RACE=1 (instrumented locks, lock-order"
+	@echo "                      + hold-time enforcement, guarded-attr checks)"
+	@echo "  make bench          end-to-end throughput benchmark (bench.py;"
+	@echo "                      BENCH_OUT=<path> writes the JSON atomically)"
 
 lint:
 	./tools/ci_lint.sh
+
+lock-graph:
+	python -m tools.kubelint kubetpu/ --lock-graph
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
@@ -16,6 +35,12 @@ test:
 sanitize-test:
 	JAX_PLATFORMS=cpu KUBETPU_SANITIZE=1 python -m pytest \
 		tests/test_sanitize.py -q -p no:cacheprovider
+
+# the race harness: stress tests with instrumented locks + guarded-attr
+# enforcement (utils/racecheck.py); KUBETPU_RACE=1 arms it process-wide
+race-test:
+	JAX_PLATFORMS=cpu KUBETPU_RACE=1 python -m pytest \
+		tests/test_racecheck.py -q -p no:cacheprovider
 
 bench:
 	python bench.py

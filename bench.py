@@ -26,7 +26,13 @@ Extra cases in the detail line:
 Every unscheduled pod is attributed to the filter(s) that blocked it
 (programs.explain_filters) — no unexplained failures.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
+"repeat_raw_s", "spread"} — per-repeat raw numbers and the min/median
+warm spread ride next to the best-of headline so regressions are
+distinguishable from tunnel variance.  BENCH_OUT=<path> additionally
+writes {"headline", "detail"} to that path ATOMICALLY (tempfile + fsync +
+os.replace; see atomic_write_json) so a timeout mid-run can never commit
+a truncated document.
 """
 
 from __future__ import annotations
@@ -84,6 +90,44 @@ def _percentile(xs, q):
     return xs[i]
 
 
+def _median(xs):
+    return _percentile(xs, 0.5)
+
+
+def atomic_write_json(path, doc) -> None:
+    """Crash-safe JSON write: tempfile in the target directory + flush +
+    fsync + os.replace, so a reader (or a kill mid-run) never sees a
+    truncated document — round-5's committed bench JSON was cut mid-file
+    and unverifiable."""
+    import tempfile
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(path) + ".",
+                               suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _spread(raw):
+    """min/median spread next to the best-of headline so a regression is
+    distinguishable from tunnel variance (warm attempts only — attempt 0
+    pays compiles)."""
+    if not raw:
+        return {}
+    return {"min_s": round(min(raw), 3),
+            "median_s": round(_median(raw), 3),
+            "max_s": round(max(raw), 3)}
+
+
 def run_mode(mode, n_nodes, n_pods, existing_per_node, repeats,
              mesh_shape=None, batch_cap=None, chain=None, ipa_heavy=False,
              pipeline=False):
@@ -104,6 +148,7 @@ def run_mode(mode, n_nodes, n_pods, existing_per_node, repeats,
     first = None
     stats = None
     outcomes = sched = None
+    raw_s = []            # every attempt's e2e seconds, in order
     for attempt in range(repeats + 1):
         if sched is not None:
             sched.close()
@@ -132,11 +177,14 @@ def run_mode(mode, n_nodes, n_pods, existing_per_node, repeats,
             cycle_rounds.append(sched.last_gang_rounds)
             outcomes.extend(out)
         dt = time.time() - t0
+        raw_s.append(round(dt, 3))
         if attempt == 0:
             first = dt
         else:
             best = min(best, dt)
         stats = {
+            "repeat_raw_s": list(raw_s),
+            "spread": _spread(raw_s[1:]),   # warm attempts only
             "cycles": len(cycle_times),
             "cycle_p50_s": round(_percentile(cycle_times, 0.5), 3),
             "cycle_p99_s": round(_percentile(cycle_times, 0.99), 3),
@@ -281,6 +329,7 @@ def pv_heavy_case(n_nodes=1000, n_pods=2048):
     best = None
     stats = {}
     sched = None
+    raw_s = []
     for attempt in range(2):
         if sched is not None:
             sched.close()
@@ -299,6 +348,7 @@ def pv_heavy_case(n_nodes=1000, n_pods=2048):
                 break
             outcomes.extend(got)
         dt = time.time() - t0
+        raw_s.append(round(dt, 3))
         if best is None or dt < best:
             best = dt
             stats = {
@@ -310,6 +360,8 @@ def pv_heavy_case(n_nodes=1000, n_pods=2048):
                                     / max(dt, 1e-9), 3),
                 "pods_per_sec": round(len(outcomes) / dt, 1),
             }
+    stats["repeat_raw_s"] = raw_s
+    stats["spread"] = _spread(raw_s[1:])
     sched.close()
     return stats
 
@@ -324,6 +376,7 @@ def preemption_case(n_nodes=500, fillers=2000, high_prio=256):
     the per-attempt cycle count and device-wait/host split reported."""
     from kubetpu.harness.perf import Workload, run_workload
     best = None
+    raw = []       # per-attempt average preempting pods/s, in order
     for attempt in range(2):
         t0 = time.time()
         items = run_workload(Workload(
@@ -342,9 +395,15 @@ def preemption_case(n_nodes=500, fillers=2000, high_prio=256):
                "device_wait_s": stats.get("DeviceWaitS", 0.0),
                "host_share": stats.get("HostShare", 0.0),
                "preempting_pods_per_sec": thr}
+        raw.append(round(thr.get("Average", 0.0), 2))
         if (best is None or thr.get("Average", 0.0)
                 > best["preempting_pods_per_sec"].get("Average", 0.0)):
             best = cur
+    if best is not None:
+        best["repeat_raw_pods_per_sec"] = raw
+        warm = raw[1:] or raw
+        best["spread"] = {"min": min(warm), "median": _median(warm),
+                          "max": max(warm)}
     return best
 
 
@@ -417,6 +476,7 @@ def rescore_case(n_pods=51200, n_nodes=10240, chunk=4096):
 
     out = {}
     first_e2e = None
+    raw_s = []
     for attempt in range(2):   # attempt 0 pays the P-bucket compile ladder
         store, pending = build_world(n_nodes, n_pods, existing_per_node=1)
         cfg = KubeSchedulerConfiguration(
@@ -438,11 +498,14 @@ def rescore_case(n_pods=51200, n_nodes=10240, chunk=4096):
             cycle_times.append(time.time() - tc)
             outcomes.extend(got)
         dt = time.time() - t0
+        raw_s.append(round(dt, 3))
         scheduled = sum(1 for o in outcomes if o.node)
         mem = jax.local_devices()[0].memory_stats() or {}
         if attempt == 0:
             first_e2e = dt
         out = {
+            "repeat_raw_s": list(raw_s),
+            "spread": _spread(raw_s[1:]),
             "pods": n_pods, "nodes": n_nodes, "chunk": chunk,
             "semantics": "distinct pods/chunk, tensorize on-clock, "
                          "placements committed between chunks",
@@ -517,12 +580,18 @@ def main() -> None:
     # experimental scale must never cost the recorded number
     mode, pods_per_sec = headline
     baseline = 30.0  # reference hard throughput floor (scheduler_test.go:40)
-    print(json.dumps({
+    hl = detail.get(mode, {})
+    headline_doc = {
         "metric": f"e2e_{mode}_throughput_{n_pods}pods_{n_nodes}nodes",
         "value": round(pods_per_sec, 1),
         "unit": "pods/s",
         "vs_baseline": round(pods_per_sec / baseline, 2),
-    }), flush=True)
+        # per-repeat raw + min/median spread: best-of alone cannot tell a
+        # regression from tunnel variance
+        "repeat_raw_s": hl.get("repeat_raw_s", []),
+        "spread": hl.get("spread", {}),
+    }
+    print(json.dumps(headline_doc), flush=True)
 
     if os.environ.get("BENCH_CHAIN_DRAIN", "1") == "1" and mesh_shape is None:
         try:
@@ -572,10 +641,15 @@ def main() -> None:
         except Exception as e:  # pragma: no cover
             northstar["warm_restart_5120n"] = {"error": repr(e)}
         detail["northstar"] = northstar
-        with open("NORTHSTAR.json", "w") as f:
-            json.dump(northstar, f, indent=1)
+        atomic_write_json("NORTHSTAR.json", northstar)
 
     print(json.dumps({"detail": detail}), file=sys.stderr)
+    # BENCH_OUT=<path>: the committed BENCH_*.json artifact, written
+    # atomically so a timeout/kill mid-run can never truncate it
+    out_path = os.environ.get("BENCH_OUT")
+    if out_path:
+        atomic_write_json(out_path,
+                          {"headline": headline_doc, "detail": detail})
 
 
 if __name__ == "__main__":

@@ -1,12 +1,19 @@
 """kubetpu: TPU-native batch scheduler.
 
-Importing the package arms the opt-in runtime sanitizer when
-``KUBETPU_SANITIZE=1`` (see utils/sanitize.py): jax_debug_nans,
-rank-promotion errors, donation-mismatch logging and the per-program
-compile-count watchdog.  Off (the default) this import touches nothing
-and does not import jax.
+Importing the package arms the opt-in runtime harnesses:
+
+* ``KUBETPU_SANITIZE=1`` (utils/sanitize.py): jax_debug_nans,
+  rank-promotion errors, donation-mismatch logging and the per-program
+  compile-count watchdog;
+* ``KUBETPU_RACE=1`` (utils/racecheck.py): instrumented locks (order +
+  hold-time enforcement) and guarded-attribute mutation checks for the
+  threaded host path.
+
+Off (the default) this import touches nothing and does not import jax.
 """
 
+from .utils.racecheck import maybe_enable_from_env as _maybe_racecheck
 from .utils.sanitize import maybe_enable_from_env as _maybe_sanitize
 
 _maybe_sanitize()
+_maybe_racecheck()

@@ -39,8 +39,8 @@ class APIServer:
                  port: int = 0):
         self.store = store
         self.host, self.port = host, port
-        self._events = collections.deque(maxlen=WATCH_BUFFER)
-        self._seq = 0
+        self._events = collections.deque(maxlen=WATCH_BUFFER)  # kubelint: guarded-by(_cond)
+        self._seq = 0  # kubelint: guarded-by(_cond)
         self._cond = threading.Condition()
         # ThreadingHTTPServer handles writers concurrently, but the store
         # fans events out AFTER releasing its lock — two racing writes
@@ -330,8 +330,10 @@ class RestClusterStore(ClusterStore):
                     continue
                 self._synced.set()
             try:
+                # client bound = server hold (10 s) + slack, so close()'s
+                # join bound below really does cover one poll round trip
                 doc = self._req("GET", f"/watch?since={seq}&timeout=10",
-                                timeout=40.0)
+                                timeout=12.0)
             except Exception:  # noqa: BLE001 — retry after transport error
                 if self._stop.wait(0.5):
                     return
@@ -363,7 +365,17 @@ class RestClusterStore(ClusterStore):
         return self._synced.wait(timeout)
 
     def close(self) -> None:
+        """Idempotent: stops and joins the watch loop (it long-polls with a
+        12 s client timeout, so the join bound covers one poll round
+        trip).  If the thread still outlives the bound, the handle is
+        KEPT so a later close() can join it again."""
         self._stop.set()
+        t = self._watch_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=15.0)
+            if t.is_alive():
+                return
+        self._watch_thread = None
 
     # -- writes -> API server ----------------------------------------------
 

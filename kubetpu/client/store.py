@@ -43,10 +43,10 @@ class NotFound(Exception):
 class ClusterStore:
     def __init__(self):
         self._lock = threading.RLock()
-        self._objs: Dict[str, Dict[str, object]] = {k: {} for k in KINDS}
-        self._subs: Dict[str, List[Handler]] = {k: [] for k in KINDS}
+        self._objs: Dict[str, Dict[str, object]] = {k: {} for k in KINDS}  # kubelint: guarded-by(_lock)
+        self._subs: Dict[str, List[Handler]] = {k: [] for k in KINDS}  # kubelint: guarded-by(_lock)
         # PV binding assume-cache (reference: scheduler_binder assume cache)
-        self._assumed_pv: Dict[str, str] = {}   # pv name -> pvc name
+        self._assumed_pv: Dict[str, str] = {}   # pv name -> pvc name  # kubelint: guarded-by(_lock)
 
     # -- generic ------------------------------------------------------------
 
@@ -66,10 +66,6 @@ class ClusterStore:
             current = list(self._objs[kind].values())
         for obj in current:
             handler("add", None, obj)
-
-    def _emit(self, kind: str, event: str, old, new) -> None:
-        for h in self._subs[kind]:
-            h(event, old, new)
 
     def add(self, obj) -> None:
         kind = obj.kind

@@ -104,7 +104,7 @@ class CycleState:
     (reference: framework/v1alpha1/cycle_state.go:40)."""
 
     def __init__(self):
-        self._data: Dict[str, object] = {}
+        self._data: Dict[str, object] = {}  # kubelint: guarded-by(_lock)
         self._lock = threading.RLock()
         self.record_plugin_metrics = False
 
@@ -272,7 +272,7 @@ class WaitingPod:
 
     def __init__(self, pod: api.Pod, plugin_timeouts: Dict[str, float]):
         self.pod = pod
-        self._pending = dict(plugin_timeouts)
+        self._pending = dict(plugin_timeouts)  # kubelint: guarded-by(_cond)
         self._cond = threading.Condition()
         self._status: Optional[Status] = None
         self._deadline = time.time() + (max(plugin_timeouts.values())
@@ -315,7 +315,7 @@ class WaitingPodsMap:
     """reference: waiting_pods_map.go:29."""
 
     def __init__(self):
-        self._pods: Dict[str, WaitingPod] = {}
+        self._pods: Dict[str, WaitingPod] = {}  # kubelint: guarded-by(_lock)
         self._lock = threading.RLock()
 
     def add(self, wp: WaitingPod) -> None:

@@ -56,8 +56,8 @@ class PodNominator:
     scheduling_queue.go:737 nominatedPodMap)."""
 
     def __init__(self):
-        self._nominated: Dict[str, List[api.Pod]] = {}
-        self._nominated_pod_to_node: Dict[str, str] = {}
+        self._nominated: Dict[str, List[api.Pod]] = {}  # kubelint: guarded-by(_lock)
+        self._nominated_pod_to_node: Dict[str, str] = {}  # kubelint: guarded-by(_lock)
         self._lock = threading.Lock()
 
     def add_nominated_pod(self, pod: api.Pod, node_name: str) -> None:
@@ -126,11 +126,11 @@ class SchedulingQueue(PodNominator):
         self._closed = False
         key = lambda qp: _pod_key(qp.pod)
         m = metrics
-        self.active_q = Heap(key, sort_key,
+        self.active_q = Heap(key, sort_key,  # kubelint: guarded-by(_cond)
                              m.active_recorder() if m else None)
-        self.backoff_q = Heap(key, self._backoff_time,
+        self.backoff_q = Heap(key, self._backoff_time,  # kubelint: guarded-by(_cond)
                               m.backoff_recorder() if m else None)
-        self.unschedulable_q: Dict[str, QueuedPodInfo] = {}
+        self.unschedulable_q: Dict[str, QueuedPodInfo] = {}  # kubelint: guarded-by(_cond)
         self._unschedulable_recorder = m.unschedulable_recorder() if m else None
         self._metrics = metrics
         self.scheduling_cycle = 0           # reference: :120
@@ -162,7 +162,11 @@ class SchedulingQueue(PodNominator):
             self.active_q.add(qp)
             self.backoff_q.delete(qp)
             self.unschedulable_q.pop(_pod_key(pod), None)
-            self._add(pod, "")
+            # via the public wrapper: the nominator maps are _lock-guarded
+            # and preemption threads mutate them concurrently — the old
+            # direct self._add() bypassed _lock (caught by
+            # concurrency/unguarded-access)
+            self.add_nominated_pod(pod, "")
             if self._metrics:
                 self._metrics.incoming("PodAdd", "active")
             self._cond.notify()
@@ -198,7 +202,7 @@ class SchedulingQueue(PodNominator):
                 if self._metrics:
                     self._metrics.incoming("ScheduleAttemptFailure",
                                            "unschedulable")
-            self._add(qp.pod, "")
+            self.add_nominated_pod(qp.pod, "")
             self._cond.notify()
 
     def pop(self, timeout: Optional[float] = None) -> Optional[QueuedPodInfo]:
@@ -235,12 +239,12 @@ class SchedulingQueue(PodNominator):
         out.append(first)
         if (timeout is None or timeout > 0) and len(out) < max_batch:
             gather = 0.02 if timeout is None else min(0.02, timeout)
-            deadline = time.time() + gather
-            while time.time() < deadline:
-                with self._cond:
-                    if len(self.active_q) >= max_batch - len(out):
-                        break   # a full batch already landed
-                time.sleep(0.002)
+            with self._cond:
+                # one cond wait instead of a 2 ms poll loop: wakes on the
+                # notify that completes the batch, or at the window's end
+                self._cond.wait_for(
+                    lambda: len(self.active_q) >= max_batch - len(out),
+                    timeout=gather)
         with self._cond:
             while len(out) < max_batch and len(self.active_q) > 0:
                 qp = self.active_q.pop()
@@ -287,7 +291,7 @@ class SchedulingQueue(PodNominator):
                 return
             # unknown pod: treat as new
             self.active_q.add(self._new_queued_pod_info(new))
-            self._add(new, "")
+            self.add_nominated_pod(new, "")
             self._cond.notify()
 
     def delete(self, pod: api.Pod) -> None:
@@ -381,10 +385,19 @@ class SchedulingQueue(PodNominator):
             self._threads.append(t)
 
     def close(self) -> None:
+        """Idempotent: stops the flush threads, wakes every blocked pop,
+        and joins the flushers (with a timeout — they sleep up to their
+        flush period on the stop event) so no daemon thread outlives the
+        queue it mutates."""
         self._stop.set()
         with self._cond:
             self._closed = True
             self._cond.notify_all()
+        # join OUTSIDE the lock: a flusher mid-flush needs _cond to finish
+        for t in self._threads:
+            if t.is_alive():
+                t.join(timeout=2.0)
+        self._threads = []
 
     # -- introspection ------------------------------------------------------
 

@@ -148,7 +148,8 @@ class Scheduler:
         # tensors, so successive drain cycles skip the full re-tensorize.
         # Any store event the chain does not account for (node changes,
         # external binds, deletions) marks it dirty -> full rebuild.
-        self._chain = None        # dict(builder, cluster, pod_uids, caps)
+        # written by bind threads (_forget) racing the serving thread
+        self._chain = None  # dict(builder, cluster, pod_uids, caps)  # kubelint: guarded-by(_chain_lock)
         # monotonic event sequence: handlers bump it AFTER mutating the
         # cache.  The scheduler captures the sequence BEFORE snapshotting,
         # so "bump visible in the capture" implies "mutation visible to the
@@ -187,6 +188,8 @@ class Scheduler:
                                              thread_name_prefix="binder")
         self._inflight_binds: List = []
         self._stop = threading.Event()
+        self._serve_thread: Optional[threading.Thread] = None
+        self._closed = False
         self._add_all_event_handlers()
         # reference: scheduler.go:548 — preemption runs unless disabled
         # (DisablePreemption componentconfig field)
@@ -505,7 +508,8 @@ class Scheduler:
                       pods=len(qpods))
         # capture the event sequence BEFORE snapshotting: a chain is only
         # reusable if no event has landed since the state it embeds
-        chain_seq0 = self._chain_seq
+        with self._chain_lock:
+            chain_seq0 = self._chain_seq
         # ---- snapshot (reference: generic_scheduler.go:155 snapshot())
         self.cache.update_snapshot(self.snapshot)
         node_infos = self.snapshot.node_info_list
@@ -549,7 +553,8 @@ class Scheduler:
         # addNominatedPods topology overlay) — their vocab must be interned
         # before snapshot arrays are sized
         nom_pinfos = [PodInfo(pod) for pod, _ in self.queue.all_nominated()]
-        chain = self._chain
+        with self._chain_lock:
+            chain = self._chain
         use_chain = (chain is not None and chain["seq"] == chain_seq0
                      and self._chain_enabled(fwk)
                      and chain["profile"] == fwk.profile_name
@@ -572,7 +577,8 @@ class Scheduler:
                               for pi in ni.pods]
             chain_pod_uids += [None] * (int(cluster.pod_valid.shape[0])
                                         - len(chain_pod_uids))
-            self._chain = None
+            with self._chain_lock:
+                self._chain = None
         spread_sels = [self.store.default_spread_selector(pi.pod)
                        for pi in pinfos]
         pb = PodBatchBuilder(builder.table)
@@ -832,12 +838,16 @@ class Scheduler:
             uids.extend(pi.pod.uid for pi in prep.pinfos)
             uids.extend([None] * (B_cap - len(prep.pinfos)))  # batch padding
             uids.extend([None] * (pow2_bucket(p_next) - len(uids)))
-            self._chain = dict(builder=prep.builder, cluster=next_cluster,
-                               pod_uids=uids, seq=prep.chain_seq0,
-                               caps=_vocab_caps(prep.builder.table),
-                               profile=fwk.profile_name, n_nodes=n_nodes)
+            with self._chain_lock:
+                self._chain = dict(builder=prep.builder,
+                                   cluster=next_cluster,
+                                   pod_uids=uids, seq=prep.chain_seq0,
+                                   caps=_vocab_caps(prep.builder.table),
+                                   profile=fwk.profile_name,
+                                   n_nodes=n_nodes)
         elif self.config.mode == "gang":
-            self._chain = None
+            with self._chain_lock:
+                self._chain = None
         return res
 
     def _finish_group(self, prep: PreparedCycle, res) -> List[ScheduleOutcome]:
@@ -941,7 +951,8 @@ class Scheduler:
         # reads _last_commit_failed and re-runs that cycle)
         self._last_commit_failed = commit_failed
         if commit_failed and self.config.mode == "gang":
-            self._chain = None
+            with self._chain_lock:
+                self._chain = None
         trace.step("Committing placements done")
         trace.log_if_long()
         return outcomes
@@ -1288,9 +1299,11 @@ class Scheduler:
 
     def _forget(self, assumed: api.Pod) -> None:
         # a rolled-back placement invalidates the chained cluster (it may
-        # already carry this pod's usage)
-        self._chain = None
-        self._mark_chain_dirty()
+        # already carry this pod's usage); one locked block so a concurrent
+        # _prepare_group can never see the seq bump without the None
+        with self._chain_lock:
+            self._chain = None
+            self._chain_seq += 1
         try:
             self.cache.forget_pod(assumed)
         except ValueError:
@@ -1566,6 +1579,7 @@ class Scheduler:
                     time.sleep(0.1)
         t = threading.Thread(target=loop, daemon=True,
                              name="kubetpu-scheduler")
+        self._serve_thread = t
         t.start()
         return t
 
@@ -1576,11 +1590,28 @@ class Scheduler:
         self._inflight_binds = [f for f in self._inflight_binds if not f.done()]
 
     def close(self) -> None:
+        """Idempotent shutdown: stop the serving loop and JOIN it before
+        flushing, so the pipeline flush cannot race a cycle in flight —
+        if the loop outlives the join bound (a cold cycle can be paying a
+        multi-second compile), the in-flight cycle is left to that loop
+        and NOT flushed here.  Then close the queue (wakes blocked pops,
+        joins flushers), the cache (joins cleanup), and the bind pool."""
+        if self._closed:
+            return
+        self._closed = True
         self._stop.set()
-        try:
-            self.flush_pipeline()
-        except Exception:
-            pass
+        t = self._serve_thread
+        serve_loop_live = False
+        if (t is not None and t is not threading.current_thread()
+                and t.is_alive()):
+            t.join(timeout=2.0)
+            serve_loop_live = t.is_alive()
+        self._serve_thread = None
+        if not serve_loop_live:
+            try:
+                self.flush_pipeline()
+            except Exception:
+                pass
         self.queue.close()
         self.cache.close()
         self._bind_pool.shutdown(wait=False)

@@ -74,11 +74,11 @@ class SchedulerCache:
         self._ttl = ttl
         self._clock = clock
         self._lock = threading.RLock()
-        self.nodes: Dict[str, _NodeItem] = {}
-        self.head: Optional[_NodeItem] = None
-        self.node_tree = NodeTree()
-        self.assumed_pods: Dict[str, bool] = {}      # uid -> true
-        self.pod_states: Dict[str, _PodState] = {}   # uid -> state
+        self.nodes: Dict[str, _NodeItem] = {}  # kubelint: guarded-by(_lock)
+        self.head: Optional[_NodeItem] = None  # kubelint: guarded-by(_lock)
+        self.node_tree = NodeTree()  # kubelint: guarded-by(_lock)
+        self.assumed_pods: Dict[str, bool] = {}      # uid -> true  # kubelint: guarded-by(_lock)
+        self.pod_states: Dict[str, _PodState] = {}   # uid -> state  # kubelint: guarded-by(_lock)
         self._stop = threading.Event()
         self._cleanup_period = cleanup_period
         self._thread: Optional[threading.Thread] = None
@@ -369,7 +369,13 @@ class SchedulerCache:
         self._thread.start()
 
     def close(self) -> None:
+        """Idempotent: stops and joins the cleanup thread (it sleeps on the
+        stop event, so it exits within one wait tick)."""
         self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=2.0)
+        self._thread = None
 
     # -- debugging ----------------------------------------------------------
 

@@ -80,9 +80,9 @@ class EventBroadcaster:
         self._window = window
         self._max = max_entries
         self._lock = threading.Lock()
-        self._cache: "OrderedDict[Tuple, Event]" = OrderedDict()
-        self._watchers: List[Callable[[Event], None]] = []
-        self._seq = 0
+        self._cache: "OrderedDict[Tuple, Event]" = OrderedDict()  # kubelint: guarded-by(_lock)
+        self._watchers: List[Callable[[Event], None]] = []  # kubelint: guarded-by(_lock)
+        self._seq = 0  # kubelint: guarded-by(_lock)
 
     def new_recorder(self, component: str = "default-scheduler"
                      ) -> EventRecorder:
@@ -90,13 +90,17 @@ class EventBroadcaster:
 
     def start_structured_logging(self, log_fn) -> None:
         """reference: event_broadcaster.go StartStructuredLogging."""
-        self._watchers.append(
-            lambda ev: log_fn(f"{ev.type} {ev.reason} "
-                              f"{ev.involved_namespace}/{ev.involved_name}: "
-                              f"{ev.message} (x{ev.count})"))
+        with self._lock:
+            self._watchers.append(
+                lambda ev: log_fn(f"{ev.type} {ev.reason} "
+                                  f"{ev.involved_namespace}/"
+                                  f"{ev.involved_name}: "
+                                  f"{ev.message} (x{ev.count})"))
 
     def watch(self, fn: Callable[[Event], None]) -> None:
-        self._watchers.append(fn)
+        # registration races _record's watcher snapshot without the lock
+        with self._lock:
+            self._watchers.append(fn)
 
     def _record(self, component: str, obj, type_: str, reason: str,
                 message: str) -> None:

@@ -39,8 +39,8 @@ class FeatureGate:
     """reference: featuregate/feature_gate.go:33."""
 
     def __init__(self, known: Dict[str, FeatureSpec] = None):
-        self._known = dict(known if known is not None else DEFAULT_FEATURES)
-        self._enabled: Dict[str, bool] = {}
+        self._known = dict(known if known is not None else DEFAULT_FEATURES)  # kubelint: guarded-by(_lock)
+        self._enabled: Dict[str, bool] = {}  # kubelint: guarded-by(_lock)
         self._lock = threading.Lock()
 
     def enabled(self, key: str) -> bool:

@@ -34,12 +34,15 @@ class Counter:
             self._vals[labels] = self._vals.get(labels, 0.0) + amount
 
     def value(self, *labels) -> float:
-        return self._vals.get(labels, 0.0)
+        with self._lock:
+            return self._vals.get(labels, 0.0)
 
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} counter"]
-        for labels, v in sorted(self._vals.items()):
+        with self._lock:
+            items = sorted(self._vals.items())
+        for labels, v in items:
             out.append(f"{self.name}{_fmt(self.label_names, labels)} {v}")
         return out
 
@@ -58,7 +61,9 @@ class Gauge(Counter):
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} gauge"]
-        for labels, v in sorted(self._vals.items()):
+        with self._lock:
+            items = sorted(self._vals.items())
+        for labels, v in items:
             out.append(f"{self.name}{_fmt(self.label_names, labels)} {v}")
         return out
 
@@ -84,15 +89,19 @@ class Histogram:
             self._sums[labels] = self._sums.get(labels, 0.0) + value
 
     def count(self, *labels) -> int:
-        c = self._counts.get(labels)
-        return c[-1] if c else 0
+        with self._lock:
+            c = self._counts.get(labels)
+            return c[-1] if c else 0
 
     def sum(self, *labels) -> float:
-        return self._sums.get(labels, 0.0)
+        with self._lock:
+            return self._sums.get(labels, 0.0)
 
     def percentile(self, q: float, *labels) -> float:
         """Approximate quantile from bucket counts (upper bound)."""
-        c = self._counts.get(labels)
+        with self._lock:
+            c = self._counts.get(labels)
+            c = list(c) if c else None
         if not c or c[-1] == 0:
             return 0.0
         target = q * c[-1]
@@ -105,14 +114,17 @@ class Histogram:
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} histogram"]
-        for labels, counts in sorted(self._counts.items()):
+        with self._lock:
+            snapshot = sorted((k, list(v), self._sums[k])
+                              for k, v in self._counts.items())
+        for labels, counts, total in snapshot:
             for i, b in enumerate(self.buckets):
                 lb = _fmt(self.label_names + ("le",), labels + (str(b),))
                 out.append(f"{self.name}_bucket{lb} {counts[i]}")
             lb = _fmt(self.label_names + ("le",), labels + ("+Inf",))
             out.append(f"{self.name}_bucket{lb} {counts[-1]}")
             out.append(f"{self.name}_sum{_fmt(self.label_names, labels)} "
-                       f"{self._sums[labels]}")
+                       f"{total}")
             out.append(f"{self.name}_count{_fmt(self.label_names, labels)} "
                        f"{counts[-1]}")
         return out

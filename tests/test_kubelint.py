@@ -410,6 +410,342 @@ def configure():
 
 
 # ---------------------------------------------------------------------------
+# concurrency family
+
+
+CONCURRENCY_BAD_UNGUARDED = """
+import threading
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = {}
+
+    def put(self, k, v):
+        with self._lock:
+            self.items[k] = v
+
+    def drop(self, k):
+        self.items.pop(k, None)     # mutation without the lock
+"""
+
+
+def test_unguarded_write_fires(tmp_path):
+    res = lint_snippet(tmp_path, CONCURRENCY_BAD_UNGUARDED,
+                       rules=["concurrency"])
+    assert any(f.rule == "concurrency/unguarded-access"
+               and "items" in f.message for f in res.findings), \
+        "\n".join(str(f) for f in res.findings)
+
+
+def test_unguarded_read_fires(tmp_path):
+    src = """
+import threading
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = {}
+
+    def put(self, k, v):
+        with self._lock:
+            self.items[k] = v
+
+    def size(self):
+        return len(self.items)      # read without the lock
+"""
+    res = lint_snippet(tmp_path, src, rules=["concurrency"])
+    assert any(f.rule == "concurrency/unguarded-access"
+               and "read" in f.message for f in res.findings)
+
+
+def test_locked_helper_quiet(tmp_path):
+    """A private helper whose every call site holds the lock is analyzed
+    as entered with it held — no finding."""
+    src = """
+import threading
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = {}
+
+    def put(self, k, v):
+        with self._lock:
+            self._store(k, v)
+
+    def replace(self, k, v):
+        with self._lock:
+            self._store(k, v)
+
+    def _store(self, k, v):
+        self.items[k] = v
+"""
+    res = lint_snippet(tmp_path, src, rules=["concurrency"])
+    assert res.clean, "\n".join(str(f) for f in res.findings)
+
+
+def test_helper_reachable_without_lock_fires(tmp_path):
+    """One lock-free call site poisons the helper's entry set: its
+    guarded accesses become reachable from a thread entry point."""
+    src = """
+import threading
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = {}
+
+    def put(self, k, v):
+        with self._lock:
+            self._store(k, v)
+
+    def put_fast(self, k, v):
+        self._store(k, v)           # bypasses the lock
+
+    def _store(self, k, v):
+        self.items[k] = v
+"""
+    res = lint_snippet(tmp_path, src, rules=["concurrency"])
+    assert any(f.rule == "concurrency/unguarded-access"
+               for f in res.findings)
+
+
+def test_guarded_by_annotation_and_optout(tmp_path):
+    """Explicit guarded-by() declares ownership inference can't see;
+    guarded-by(none) opts a deliberately unguarded attribute out."""
+    src = """
+import threading
+
+class Box:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.store = Ext()  # kubelint: guarded-by(_mu)
+        self.flag = {}  # kubelint: guarded-by(none)
+
+    def read(self):
+        return self.store           # declared guarded: fires
+
+    def poke(self):
+        with self._mu:
+            self.flag["x"] = 1
+
+    def poke_free(self):
+        self.flag["x"] = 2          # opted out: quiet
+
+
+class Ext:
+    pass
+"""
+    res = lint_snippet(tmp_path, src, rules=["concurrency"])
+    msgs = [f.message for f in res.findings
+            if f.rule == "concurrency/unguarded-access"]
+    assert any("store" in m and "declared" in m for m in msgs), msgs
+    assert not any("flag" in m for m in msgs), msgs
+
+
+def test_lock_order_cycle_fires(tmp_path):
+    src = """
+import threading
+
+class AB:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def one(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def two(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+    res = lint_snippet(tmp_path, src, rules=["concurrency"])
+    assert any(f.rule == "concurrency/lock-order"
+               and "cycle" in f.message for f in res.findings)
+
+
+def test_lock_order_consistent_quiet(tmp_path):
+    src = """
+import threading
+
+class AB:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def one(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def two(self):
+        with self._a:
+            with self._b:
+                pass
+"""
+    res = lint_snippet(tmp_path, src, rules=["concurrency"])
+    assert not any(f.rule == "concurrency/lock-order"
+                   for f in res.findings)
+
+
+def test_lock_order_cycle_across_classes(tmp_path):
+    """The graph follows calls made while holding a lock through
+    `self.attr = OtherClass()` bindings."""
+    src = """
+import threading
+
+class Inner:
+    def __init__(self):
+        self._ilock = threading.Lock()
+
+    def touch(self):
+        with self._ilock:
+            pass
+
+
+class Outer:
+    def __init__(self):
+        self._olock = threading.Lock()
+        self.inner = Inner()
+
+    def forward(self):
+        with self._olock:
+            self.inner.touch()
+
+    def backward(self):
+        # Inner._ilock -> Outer._olock: closes the cycle
+        with self.inner._ilock:
+            with self._olock:
+                pass
+"""
+    res = lint_snippet(tmp_path, src, rules=["concurrency"])
+    assert any(f.rule == "concurrency/lock-order"
+               and "cycle" in f.message for f in res.findings), \
+        "\n".join(str(f) for f in res.findings)
+
+
+def test_blocking_sleep_under_lock_fires(tmp_path):
+    src = """
+import threading
+import time
+
+class S:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def nap(self):
+        with self._lock:
+            time.sleep(0.5)
+"""
+    res = lint_snippet(tmp_path, src, rules=["concurrency"])
+    assert any(f.rule == "concurrency/blocking-under-lock"
+               for f in res.findings)
+
+
+def test_device_dispatch_under_lock_fires(tmp_path):
+    """jit-root calls and .tolist() readbacks under a lock are the
+    convoy shape the chain/pipeline regression smells of."""
+    src = """
+import threading
+import jax
+
+@jax.jit
+def program(x):
+    return x * 2
+
+class S:
+    def __init__(self):
+        self._chain_lock = threading.Lock()
+
+    def dispatch(self, x):
+        with self._chain_lock:
+            res = program(x)
+            return res.tolist()
+"""
+    res = lint_snippet(tmp_path, src, rules=["concurrency"])
+    msgs = [f.message for f in res.findings
+            if f.rule == "concurrency/blocking-under-lock"]
+    assert any("jitted program" in m for m in msgs), msgs
+    assert any("tolist" in m for m in msgs), msgs
+
+
+def test_condition_wait_on_other_lock_fires(tmp_path):
+    src = """
+import threading
+
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+
+    def bad_wait(self):
+        with self._lock:
+            with self._cond:
+                self._cond.wait(1.0)   # blocks while _lock is held
+
+    def good_wait(self):
+        with self._cond:
+            self._cond.wait(1.0)       # only its own lock: idiomatic
+"""
+    res = lint_snippet(tmp_path, src, rules=["concurrency"])
+    waits = [f for f in res.findings
+             if f.rule == "concurrency/blocking-under-lock"
+             and "wait" in f.message]
+    assert len(waits) == 1, "\n".join(str(f) for f in res.findings)
+
+
+def test_orphan_daemon_thread_fires_and_stop_event_quiet(tmp_path):
+    src = """
+import threading
+
+class Orphan:
+    def run(self):
+        t = threading.Thread(target=self._loop, daemon=True)
+        t.start()
+
+    def _loop(self):
+        while True:
+            pass
+
+
+class Stoppable:
+    def __init__(self):
+        self._stop = threading.Event()
+
+    def run(self):
+        t = threading.Thread(target=self._loop, daemon=True)
+        t.start()
+
+    def _loop(self):
+        while not self._stop.wait(1.0):
+            pass
+
+    def close(self):
+        self._stop.set()
+"""
+    res = lint_snippet(tmp_path, src, rules=["concurrency"])
+    orphans = [f for f in res.findings
+               if f.rule == "concurrency/orphan-daemon-thread"]
+    assert len(orphans) == 1
+    assert "Orphan" in orphans[0].message
+
+
+def test_lock_graph_cli(tmp_path):
+    """--lock-graph renders the ownership map the README embeds."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.kubelint", "kubetpu/", "--lock-graph"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr
+    assert "SchedulerCache" in proc.stdout
+    assert "SchedulingQueue._cond" in proc.stdout
+    assert "PodNominator._lock" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
 # suppression machinery
 
 
@@ -593,3 +929,8 @@ def test_detects_at_least_four_rule_families():
     test above; this asserts the registry agrees."""
     from tools.kubelint import RULE_FAMILIES
     assert len(RULE_FAMILIES) >= 4
+
+
+def test_concurrency_family_registered():
+    from tools.kubelint import RULE_FAMILIES
+    assert "concurrency" in RULE_FAMILIES

@@ -1,7 +1,9 @@
 #!/bin/sh
 # CI lint gate: kubelint in JSON mode, nonzero exit on any unsuppressed
-# finding.  Builders run this by default via `make lint`; the same check
-# gates tier-1 through tests/test_kubelint.py::test_kubetpu_tree_is_clean.
+# finding.  Covers all five rule families — host-sync, recompile, numeric,
+# purity, and concurrency (lock discipline for the threaded host path).
+# Builders run this by default via `make lint`; the same check gates
+# tier-1 through tests/test_kubelint.py::test_kubetpu_tree_is_clean.
 set -e
 cd "$(dirname "$0")/.."
 python -m tools.kubelint kubetpu/ --json

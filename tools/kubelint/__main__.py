@@ -25,6 +25,10 @@ def main(argv=None) -> int:
                          "(e.g. host-sync,numeric)")
     ap.add_argument("--show-suppressed", action="store_true",
                     help="also print suppressed findings")
+    ap.add_argument("--lock-graph", action="store_true",
+                    help="print the lock-ownership map and acquisition-"
+                         "order table instead of linting (concurrency "
+                         "family's model; README embeds this)")
     ap.add_argument("--root", default=".",
                     help="package root for dotted module names")
     args = ap.parse_args(argv)
@@ -36,6 +40,15 @@ def main(argv=None) -> int:
         print("kubelint: no Python files found under: %s"
               % " ".join(args.paths), file=sys.stderr)
         return 2
+    if args.lock_graph:
+        from . import callgraph as cg
+        from . import rules_concurrency
+        from .core import LintContext, load_modules
+        modules = load_modules(args.paths, root=args.root)
+        ctx = LintContext(modules)
+        ctx.callgraph = cg.CallGraph(modules)
+        print(rules_concurrency.render_lock_graph(ctx))
+        return 0
     result = run_lint(args.paths, root=args.root, rules=rules or None)
 
     if args.json:

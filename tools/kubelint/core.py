@@ -7,10 +7,11 @@ never check the invariants that contract rests on — no host syncs inside
 traced code, no silent recompilation, no f64 widening, no impure kernels —
 so kubelint checks them mechanically.  One module per rule family:
 
-    rules_host_sync   host-sync / tracer-leak rules      (host-sync/*)
-    rules_recompile   recompilation-hazard rules         (recompile/*)
-    rules_numeric     numeric-fidelity rules             (numeric/*)
-    rules_purity      kernel-purity rules                (purity/*)
+    rules_host_sync    host-sync / tracer-leak rules      (host-sync/*)
+    rules_recompile    recompilation-hazard rules         (recompile/*)
+    rules_numeric      numeric-fidelity rules             (numeric/*)
+    rules_purity       kernel-purity rules                (purity/*)
+    rules_concurrency  host-path lock-discipline rules    (concurrency/*)
 
 Inline suppression syntax (reason is REQUIRED):
 
@@ -197,8 +198,8 @@ def run_lint(paths: Sequence[str], root: str = ".",
     """Lint every .py file under ``paths``.  ``rules``: optional rule-id
     prefixes to restrict to (e.g. ["host-sync"])."""
     from . import callgraph as cg
-    from . import (rules_host_sync, rules_numeric, rules_purity,
-                   rules_recompile)
+    from . import (rules_concurrency, rules_host_sync, rules_numeric,
+                   rules_purity, rules_recompile)
 
     modules = load_modules(paths, root=root)
     ctx = LintContext(modules)
@@ -208,7 +209,7 @@ def run_lint(paths: Sequence[str], root: str = ".",
     for mod in modules:
         raw.extend(mod.bad_suppressions)
         for rule_mod in (rules_host_sync, rules_recompile, rules_numeric,
-                         rules_purity):
+                         rules_purity, rules_concurrency):
             raw.extend(rule_mod.check(mod, ctx))
 
     if rules:

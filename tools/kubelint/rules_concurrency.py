@@ -1,0 +1,856 @@
+"""concurrency/* — lock-discipline rules for the threaded host path.
+
+The device path is single-threaded by construction (one serving loop owns
+the jit dispatch), but the HOST path is not: ~15 threads share the cache,
+queue, store and chain state.  kube-scheduler guards its snapshot/cache/
+queue with explicit mutexes and leans on Go's race detector in CI; Python
+gives us neither, so this family checks the discipline mechanically.
+
+Lock-ownership model (per class):
+
+  * a *lock attribute* is any ``self.X = threading.Lock/RLock/Condition()``
+    assignment, plus any attribute used as a bare ``with self.X:`` context
+    (covers locks inherited from a base class in another module);
+  * an attribute is *guarded by* lock L when (a) the line assigning it in
+    ``__init__`` carries ``# kubelint: guarded-by(L)``, or (b) it is
+    mutated at least once at a program point where L is held (the
+    ``_lock/_cond/_mu`` idiom, inferred automatically);
+    ``# kubelint: guarded-by(none)`` opts an attribute out;
+  * "held" is computed lexically (enclosing ``with self.L``) PLUS a
+    must-hold entry-set fixpoint for private helpers: a helper whose every
+    intra-class call site holds L is analyzed as entered with L held.
+    Public methods, nested functions, thread targets and executor-submitted
+    callables are thread entry points and start with nothing held.
+
+Rules:
+
+  concurrency/unguarded-access   read/write of a guarded attribute at a
+                                 point reachable from a thread entry point
+                                 without the owning lock
+  concurrency/lock-order         a cycle in the static lock-acquisition
+                                 graph (with-nesting and calls made while
+                                 holding a lock, followed across classes
+                                 through ``self.attr = OtherClass()``
+                                 bindings), or re-acquiring a non-reentrant
+                                 Lock already held
+  concurrency/blocking-under-lock  device dispatch (a jit-root call,
+                                 ``.block_until_ready()``, ``.tolist()``,
+                                 ``.item()``, ``np.asarray``), a
+                                 ``Condition.wait`` that blocks while OTHER
+                                 locks are held, or a known-blocking host
+                                 call (sleep, HTTP, socket, subprocess,
+                                 flock, Future.result) under a lock
+  concurrency/orphan-daemon-thread  ``threading.Thread(daemon=True)``
+                                 spawned by a scope with no registered stop
+                                 Event (an Event whose ``.set()`` is called
+                                 somewhere in the owning class/scope; an
+                                 http server thread counts its
+                                 ``.shutdown()`` call)
+
+Known bounds (documented, not bugs): analysis is per-class — cross-object
+accesses (``self.cache.nodes``) and module-level globals are out of scope;
+base classes merge only when defined in the same module.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, SourceModule
+
+_GUARDED_RE = re.compile(r"#\s*kubelint:\s*guarded-by\(([^)]*)\)")
+
+_LOCK_TYPES = {"threading.Lock": "Lock", "threading.RLock": "RLock",
+               "threading.Condition": "Condition"}
+_EVENT_TYPE = "threading.Event"
+
+_MUTATORS = {"append", "extend", "add", "update", "insert", "setdefault",
+             "pop", "popitem", "remove", "discard", "clear", "move_to_end",
+             "appendleft", "__setitem__"}
+
+_BLOCKING_EXACT = {
+    "time.sleep": "time.sleep blocks every waiter of this lock",
+    "fcntl.flock": "fcntl.flock can block on another process",
+    "urllib.request.urlopen": "HTTP round trip under a lock",
+    "numpy.asarray": "np.asarray on a device array is a blocking readback",
+    "jax.device_get": "device readback",
+    "jax.block_until_ready": "blocks until device work completes",
+    "select.select": "select blocks",
+    "socket.create_connection": "socket connect under a lock",
+}
+_BLOCKING_PREFIXES = ("requests.", "subprocess.", "http.client.",
+                      "socket.socket")
+_DEVICE_SYNC_METHODS = {"block_until_ready", "tolist", "item"}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _root_self_attr(node: ast.AST) -> Optional[str]:
+    """Peel Subscript/Attribute chains down to a ``self.X`` root:
+    ``self._objs[kind][k]`` -> ``_objs``."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        a = _self_attr(node)
+        if a is not None:
+            return a
+        node = node.value
+    return None
+
+
+class _Method:
+    def __init__(self, name: str, node: ast.AST, external: bool):
+        self.name = name
+        self.node = node
+        self.external = external
+        # (attr, "read"|"write", line, col, frozenset(held))
+        self.accesses: List[Tuple[str, str, int, int, frozenset]] = []
+        # intra-class calls: (callee name, line, frozenset(held))
+        self.calls: List[Tuple[str, int, frozenset]] = []
+        # potential blocking sites: (line, col, description, frozenset(held))
+        self.blocking: List[Tuple[int, int, str, frozenset]] = []
+        # cross-class calls: (attr, method name, line, frozenset(held))
+        self.xcalls: List[Tuple[str, str, int, frozenset]] = []
+        # lock acquisitions: (token, line, col, frozenset(held before));
+        # a token is an own-lock attr name, or ("foreign", attr, lockattr)
+        # for `with self.attr._lock:` acquisitions of another class's lock
+        self.withs: List[Tuple[object, int, int, frozenset]] = []
+        # daemon-thread spawns: (line, col, target expr)
+        self.spawns: List[Tuple[int, int, Optional[ast.AST]]] = []
+        self.must_entry: frozenset = frozenset()
+        self.may_entry: frozenset = frozenset()
+
+
+class _ClassInfo:
+    def __init__(self, module: SourceModule, node: ast.ClassDef):
+        self.module = module
+        self.node = node
+        self.name = node.name
+        self.locks: Dict[str, str] = {}       # attr -> kind ("?" unknown)
+        self.lock_definer: Dict[str, str] = {}  # attr -> defining class name
+        self.events: Set[str] = set()
+        self.event_set_calls: Set[str] = set()  # event attrs with .set()
+        self.shutdown_attrs: Set[str] = set()   # self.X with .shutdown()
+        self.methods: Dict[str, _Method] = {}
+        self.explicit: Dict[str, str] = {}      # attr -> lock (annotation)
+        self.optout: Set[str] = set()
+        self.guarded: Dict[str, str] = {}       # attr -> owning lock attr
+        self.attr_classes: Dict[str, Tuple[str, str]] = {}  # attr -> (mod, cls)
+        # attrs initialized as plain containers: only these take mutator-
+        # call writes (`self.x.update(...)` on a domain object is a method
+        # call, not a container mutation)
+        self.container_attrs: Set[str] = set()
+        self.method_names: Set[str] = set()
+        self.bases: List[str] = [b.id for b in node.bases
+                                 if isinstance(b, ast.Name)]
+
+    def key(self) -> Tuple[str, str]:
+        return (self.module.name, self.name)
+
+
+class _State:
+    def __init__(self):
+        self.classes: Dict[Tuple[str, str], _ClassInfo] = {}
+        # lock graph: (a, b) -> (path, line); node = "Class.attr"
+        self.edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        self.findings: Dict[str, List[Finding]] = {}
+
+    def add(self, f: Finding) -> None:
+        self.findings.setdefault(f.path, []).append(f)
+
+
+# ---------------------------------------------------------------------------
+# per-class scan
+
+
+class _ClassScanner:
+    def __init__(self, ci: _ClassInfo, cg, mi):
+        self.ci = ci
+        self.cg = cg
+        self.mi = mi
+        self._callback_names: Set[str] = set()
+
+    def scan(self) -> None:
+        ci = self.ci
+        for stmt in ci.node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ci.method_names.add(stmt.name)
+        self._collect_locks()
+        for stmt in ci.node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                external = not stmt.name.startswith("_") or (
+                    stmt.name.startswith("__") and stmt.name.endswith("__"))
+                m = _Method(stmt.name, stmt, external)
+                ci.methods[stmt.name] = m
+                for s in stmt.body:
+                    self._visit(s, frozenset(), m)
+        self._mark_callback_externals()
+
+    # -- lock/annotation discovery -----------------------------------------
+
+    def _collect_locks(self) -> None:
+        ci = self.ci
+        for node in ast.walk(ci.node):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                val = node.value
+                for t in targets:
+                    a = _self_attr(t)
+                    if a is None or val is None:
+                        continue
+                    if isinstance(val, (ast.Dict, ast.List, ast.Set,
+                                        ast.DictComp, ast.ListComp,
+                                        ast.SetComp)):
+                        ci.container_attrs.add(a)
+                    if not isinstance(val, ast.Call):
+                        continue
+                    dotted = self.cg.resolve_dotted(self.mi, val.func)
+                    if dotted in _LOCK_TYPES:
+                        ci.locks[a] = _LOCK_TYPES[dotted]
+                        ci.lock_definer[a] = ci.name
+                    elif dotted == _EVENT_TYPE:
+                        ci.events.add(a)
+                    elif dotted in ("dict", "list", "set",
+                                    "collections.OrderedDict",
+                                    "collections.deque",
+                                    "collections.defaultdict",
+                                    "OrderedDict", "deque", "defaultdict"):
+                        ci.container_attrs.add(a)
+                    else:
+                        # self.x = SomeClass(...): class-typed attribute
+                        tgt = self._class_target(val.func)
+                        if tgt is not None:
+                            ci.attr_classes[a] = tgt
+            # bare `with self.X:` marks X lock-like even when the
+            # constructor lives in a cross-module base class
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    a = _self_attr(item.context_expr)
+                    if a is not None and a not in ci.locks:
+                        ci.locks[a] = "?"
+                        ci.lock_definer[a] = ci.name
+            if isinstance(node, ast.Call) and isinstance(node.func,
+                                                         ast.Attribute):
+                if node.func.attr == "set":
+                    a = _self_attr(node.func.value)
+                    if a is not None:
+                        ci.event_set_calls.add(a)
+                if node.func.attr == "shutdown":
+                    a = _self_attr(node.func.value)
+                    if a is not None:
+                        ci.shutdown_attrs.add(a)
+        # guarded-by annotations on assignment lines
+        for node in ast.walk(ci.node):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                a = _self_attr(t)
+                if a is None:
+                    continue
+                line = ci.module.lines[node.lineno - 1] \
+                    if node.lineno <= len(ci.module.lines) else ""
+                mm = _GUARDED_RE.search(line)
+                if mm:
+                    lock = mm.group(1).strip()
+                    if lock.lower() == "none":
+                        ci.optout.add(a)
+                    else:
+                        ci.explicit[a] = lock
+
+    def _class_target(self, func: ast.AST) -> Optional[Tuple[str, str]]:
+        if isinstance(func, ast.Name):
+            if func.id in self.mi.from_imports:
+                base, orig = self.mi.from_imports[func.id]
+                return (base, orig)
+            return (self.ci.module.name, func.id)
+        return None
+
+    # -- body walk -----------------------------------------------------------
+
+    def _visit(self, node: ast.AST, held: frozenset, m: _Method) -> None:
+        ci = self.ci
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: List[object] = []
+            for item in node.items:
+                self._visit(item.context_expr, held, m)
+                ce = item.context_expr
+                a = _self_attr(ce)
+                if a is not None and a in ci.locks:
+                    m.withs.append((a, node.lineno, node.col_offset + 1,
+                                    held | frozenset(acquired)))
+                    acquired.append(a)
+                elif (isinstance(ce, ast.Attribute)
+                      and _self_attr(ce.value) in ci.attr_classes):
+                    tok = ("foreign", _self_attr(ce.value), ce.attr)
+                    m.withs.append((tok, node.lineno, node.col_offset + 1,
+                                    held | frozenset(acquired)))
+                    acquired.append(tok)
+            inner = held | frozenset(acquired)
+            for stmt in node.body:
+                self._visit(stmt, inner, m)
+            return
+        if isinstance(node, ast.ClassDef):
+            # a class defined inside a method (HTTP Handler pattern) has
+            # its own `self`; it is analyzed as its own class
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # nested callable: runs later (thread target, callback) —
+            # a fresh entry point holding nothing
+            nm = _Method(m.name + "." + getattr(node, "name", "<lambda>"),
+                         node, True)
+            ci.methods[nm.name] = nm
+            body = node.body if isinstance(node.body, list) else [node.body]
+            for stmt in body:
+                self._visit(stmt, frozenset(), nm)
+            return
+        if isinstance(node, ast.Call):
+            # a predicate handed to cond.wait_for runs with cond held
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "wait_for"):
+                wa = _self_attr(node.func.value)
+                if wa is not None and wa in ci.locks:
+                    self._record_call(node, held, m)
+                    self._visit(node.func, held, m)
+                    for arg in node.args + [kw.value for kw in node.keywords]:
+                        if isinstance(arg, ast.Lambda):
+                            self._visit(arg.body, held | {wa}, m)
+                        else:
+                            self._visit(arg, held, m)
+                    return
+            self._record_call(node, held, m)
+        elif isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+            self._record_write_targets(node, held, m)
+        elif isinstance(node, ast.Attribute) and isinstance(node.ctx,
+                                                            ast.Load):
+            a = _self_attr(node)
+            if (a is not None and a not in ci.locks and a not in ci.events
+                    and a not in ci.method_names):
+                m.accesses.append((a, "read", node.lineno,
+                                   node.col_offset + 1, held))
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held, m)
+
+    def _record_write_targets(self, node, held, m: _Method) -> None:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        elif isinstance(node, ast.Delete):
+            targets = node.targets
+        stack = list(targets)
+        while stack:
+            t = stack.pop()
+            if isinstance(t, (ast.Tuple, ast.List)):
+                stack.extend(t.elts)
+                continue
+            a = _root_self_attr(t)
+            if a is not None and a not in self.ci.locks \
+                    and a not in self.ci.events:
+                m.accesses.append((a, "write", node.lineno,
+                                   node.col_offset + 1, held))
+
+    def _record_call(self, node: ast.Call, held, m: _Method) -> None:
+        ci = self.ci
+        dotted = self.cg.resolve_dotted(self.mi, node.func)
+        # daemon-thread spawn
+        if dotted == "threading.Thread":
+            daemon = any(kw.arg == "daemon"
+                         and isinstance(kw.value, ast.Constant)
+                         and kw.value.value is True for kw in node.keywords)
+            if daemon:
+                target = next((kw.value for kw in node.keywords
+                               if kw.arg == "target"), None)
+                m.spawns.append((node.lineno, node.col_offset + 1, target))
+        if isinstance(node.func, ast.Attribute):
+            fa = node.func.attr
+            val = node.func.value
+            # self.method(...) — intra-class call (the name may resolve to
+            # a base-class method only after the same-module merge, so
+            # record every self.X() call; unknown names fall out of the
+            # fixpoint naturally)
+            a = _self_attr(node.func)
+            if a is not None and a not in ci.locks and a not in ci.events:
+                m.calls.append((a, node.lineno, held))
+                return
+            # self.attr.method(...) — mutator write or cross-class call
+            va = _self_attr(val)
+            if va is None:
+                va = _root_self_attr(val)
+            if va is not None:
+                if fa in _MUTATORS and va in ci.container_attrs:
+                    m.accesses.append((va, "write", node.lineno,
+                                       node.col_offset + 1, held))
+                elif va in ci.attr_classes:
+                    m.xcalls.append((va, fa, node.lineno, held))
+            # executor.submit(self.m, ...) makes m an entry point
+            if fa == "submit" and node.args:
+                sm = _self_attr(node.args[0])
+                if sm is not None and sm in ci.method_names:
+                    self._callback_names.add(sm)
+            # blocking by method name
+            if fa in _DEVICE_SYNC_METHODS:
+                m.blocking.append((node.lineno, node.col_offset + 1,
+                                   ".%s() is a blocking device readback"
+                                   % fa, held))
+            if fa in ("wait", "wait_for"):
+                wa = _self_attr(val)
+                if wa is not None and (wa in ci.locks or wa in ci.events):
+                    other = held - {wa}
+                    if other:
+                        m.blocking.append((
+                            node.lineno, node.col_offset + 1,
+                            "%s.wait blocks while still holding %s"
+                            % (wa, ", ".join(sorted(_tok_str(t)
+                                                    for t in other))),
+                            held))
+            if fa == "result":
+                m.blocking.append((node.lineno, node.col_offset + 1,
+                                   "Future.result() blocks under a lock",
+                                   held))
+        if dotted is not None:
+            if dotted in _BLOCKING_EXACT:
+                m.blocking.append((node.lineno, node.col_offset + 1,
+                                   _BLOCKING_EXACT[dotted], held))
+            elif any(dotted.startswith(p) for p in _BLOCKING_PREFIXES):
+                m.blocking.append((node.lineno, node.col_offset + 1,
+                                   "%s call blocks under a lock" % dotted,
+                                   held))
+        # jit-root dispatch under a lock (device program call)
+        fi = self.cg.info_for(ci.module,
+                              self._enclosing_fn(node))
+        if fi is not None:
+            callee = self.cg._lookup_callee(self.mi, fi, node.func)
+            if callee is not None and callee.is_root:
+                m.blocking.append((node.lineno, node.col_offset + 1,
+                                   "call to jitted program `%s` dispatches "
+                                   "device work" % callee.name, held))
+        # thread target= self.m / Name callbacks handled in post pass
+
+    def _enclosing_fn(self, node):
+        return self.ci.module.enclosing_function(node)
+
+    def _mark_callback_externals(self) -> None:
+        """A method referenced as a value (thread target, callback,
+        executor submission) is a thread entry point."""
+        ci = self.ci
+        names = set(getattr(self, "_callback_names", set()))
+        for node in ast.walk(ci.node):
+            if isinstance(node, ast.Attribute) and isinstance(node.ctx,
+                                                              ast.Load):
+                a = _self_attr(node)
+                if a in ci.method_names:
+                    parent_call = None
+                    # func position of a Call is a normal call, not a ref
+                    p = ci.module.parent(node)
+                    if isinstance(p, ast.Call) and p.func is node:
+                        parent_call = p
+                    if parent_call is None:
+                        names.add(a)
+        for n in names:
+            if n in ci.methods:
+                ci.methods[n].external = True
+
+
+
+
+# ---------------------------------------------------------------------------
+# whole-tree analysis
+
+
+def _merge_bases(state: _State) -> None:
+    """Fold same-module base classes into subclasses so inherited locks,
+    guarded attrs and helper methods resolve (PodNominator ->
+    SchedulingQueue)."""
+    for key, ci in list(state.classes.items()):
+        for base in ci.bases:
+            bkey = (ci.module.name, base)
+            bci = state.classes.get(bkey)
+            if bci is None:
+                continue
+            for a, k in bci.locks.items():
+                ci.locks.setdefault(a, k)
+                ci.lock_definer.setdefault(a, bci.lock_definer.get(a, base))
+            ci.events |= bci.events
+            ci.event_set_calls |= bci.event_set_calls
+            ci.shutdown_attrs |= bci.shutdown_attrs
+            ci.explicit = {**bci.explicit, **ci.explicit}
+            ci.optout |= bci.optout
+            for an, tc in bci.attr_classes.items():
+                ci.attr_classes.setdefault(an, tc)
+            for mn, mm in bci.methods.items():
+                ci.methods.setdefault(mn, mm)
+            ci.method_names |= bci.method_names
+
+
+def _fix_entry_sets(ci: _ClassInfo) -> None:
+    all_locks = frozenset(ci.locks)
+    for m in ci.methods.values():
+        m.must_entry = frozenset() if m.external else all_locks
+        m.may_entry = frozenset()
+    for _ in range(12):
+        changed = False
+        callers: Dict[str, List[frozenset]] = {}
+        may_callers: Dict[str, List[frozenset]] = {}
+        for m in ci.methods.values():
+            for callee, _line, held in m.calls:
+                callers.setdefault(callee, []).append(held | m.must_entry)
+                may_callers.setdefault(callee, []).append(held | m.may_entry)
+        for name, m in ci.methods.items():
+            may_sites = may_callers.get(name, [])
+            new_may = frozenset().union(*may_sites) if may_sites \
+                else frozenset()
+            if new_may != m.may_entry:
+                m.may_entry = new_may
+                changed = True
+            if m.external:
+                continue
+            sites = callers.get(name)
+            new = (frozenset.intersection(*sites) if sites
+                   else frozenset())
+            if new != m.must_entry:
+                m.must_entry = new
+                changed = True
+        if not changed:
+            break
+
+
+def _infer_guarded(ci: _ClassInfo) -> None:
+    # candidate discovery uses MAY-held (a write under the lock via ANY
+    # call path makes the attr a candidate); violation checking later
+    # uses MUST-held — that asymmetry is what catches a helper with one
+    # lock-free call site
+    candidates: Dict[str, Set[str]] = {}
+    for m in ci.methods.values():
+        if m.name == "__init__":
+            continue
+        held_base = m.may_entry
+        for attr, kind, _line, _col, held in m.accesses:
+            if kind != "write":
+                continue
+            for lock in (held | held_base):
+                if isinstance(lock, str):
+                    candidates.setdefault(attr, set()).add(lock)
+    for attr, locks in candidates.items():
+        if attr in ci.optout:
+            continue
+        if len(locks) == 1:
+            ci.guarded[attr] = next(iter(locks))
+    for attr, lock in ci.explicit.items():
+        if attr not in ci.optout:
+            ci.guarded[attr] = lock
+    for attr in ci.optout:
+        ci.guarded.pop(attr, None)
+
+
+def _tok_str(tok) -> str:
+    if isinstance(tok, tuple):
+        return "%s.%s" % (tok[1], tok[2])
+    return str(tok)
+
+
+def _lock_node(state: _State, ci: _ClassInfo, tok) -> str:
+    if isinstance(tok, tuple):
+        # ("foreign", attr, lockattr): resolve through the attr's class
+        _tag, attr, lockattr = tok
+        tgt = ci.attr_classes.get(attr)
+        if tgt is not None:
+            tci = state.classes.get(tgt)
+            if tci is not None:
+                return "%s.%s" % (tci.lock_definer.get(lockattr,
+                                                       tci.name), lockattr)
+        return "%s.%s" % (attr, lockattr)
+    return "%s.%s" % (ci.lock_definer.get(tok, ci.name), tok)
+
+
+def _transitive_acquires(state: _State) -> Dict[Tuple[str, str, str],
+                                                Set[str]]:
+    """(module, class, method) -> set of lock-graph nodes the call
+    acquires, transitively through intra- and cross-class calls."""
+    acq: Dict[Tuple[str, str, str], Set[str]] = {}
+    for key, ci in state.classes.items():
+        for mn, m in ci.methods.items():
+            acq[(key[0], key[1], mn)] = {
+                _lock_node(state, ci, a) for a, _l, _c, _h in m.withs}
+    for _ in range(6):
+        changed = False
+        for key, ci in state.classes.items():
+            for mn, m in ci.methods.items():
+                cur = acq[(key[0], key[1], mn)]
+                for callee, _line, _held in m.calls:
+                    extra = acq.get((key[0], key[1], callee), set())
+                    if not extra <= cur:
+                        cur |= extra
+                        changed = True
+                for attr, meth, _line, _held in m.xcalls:
+                    tmod, tcls = ci.attr_classes[attr]
+                    extra = acq.get((tmod, tcls, meth), set())
+                    if not extra <= cur:
+                        cur |= extra
+                        changed = True
+        if not changed:
+            break
+    return acq
+
+
+def _build_edges(state: _State, acq) -> None:
+    for key, ci in state.classes.items():
+        path = ci.module.path
+        for m in ci.methods.values():
+            base = m.must_entry
+            for attr, line, _col, held_before in m.withs:
+                b = _lock_node(state, ci, attr)
+                for a in (held_before | base):
+                    an = _lock_node(state, ci, a)
+                    if an != b:
+                        state.edges.setdefault((an, b), (path, line))
+            for callee, line, held in m.calls:
+                eff = held | base
+                if not eff:
+                    continue
+                for b in acq.get((key[0], key[1], callee), set()):
+                    for a in eff:
+                        an = _lock_node(state, ci, a)
+                        if an != b:
+                            state.edges.setdefault((an, b), (path, line))
+            for attr, meth, line, held in m.xcalls:
+                eff = held | base
+                if not eff:
+                    continue
+                tmod, tcls = ci.attr_classes[attr]
+                for b in acq.get((tmod, tcls, meth), set()):
+                    for a in eff:
+                        an = _lock_node(state, ci, a)
+                        if an != b:
+                            state.edges.setdefault((an, b), (path, line))
+
+
+def _find_cycles(state: _State) -> None:
+    graph: Dict[str, List[str]] = {}
+    for (a, b) in state.edges:
+        graph.setdefault(a, []).append(b)
+    seen_cycles: Set[Tuple[str, ...]] = set()
+    for start in sorted(graph):
+        stack = [(start, [start])]
+        visited: Set[str] = set()
+        while stack:
+            node, path = stack.pop()
+            for nxt in graph.get(node, []):
+                if nxt == start:
+                    cyc = tuple(sorted(path))
+                    if cyc in seen_cycles:
+                        continue
+                    seen_cycles.add(cyc)
+                    src, line = state.edges[(node, start)]
+                    state.add(Finding(
+                        "concurrency/lock-order", src, line, 1,
+                        "lock-order cycle: %s — threads taking these locks "
+                        "in different orders can deadlock; pick one order"
+                        % " -> ".join(path + [start])))
+                elif nxt not in visited and nxt not in path:
+                    visited.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+
+
+def _check_class(state: _State, ci: _ClassInfo) -> None:
+    path = ci.module.path
+    # unguarded access
+    for m in ci.methods.values():
+        if m.name == "__init__" or m.name.startswith("__init__."):
+            continue
+        base = m.must_entry
+        seen: Set[Tuple[str, int]] = set()
+        writes = {(a, ln) for a, k, ln, _c, _h in m.accesses
+                  if k == "write"}
+        for attr, kind, line, col, held in m.accesses:
+            owner = ci.guarded.get(attr)
+            if owner is None:
+                continue
+            if owner in (held | base):
+                continue
+            if kind == "read" and (attr, line) in writes:
+                continue  # the write finding covers this line
+            if (attr, line) in seen:
+                continue
+            seen.add((attr, line))
+            state.add(Finding(
+                "concurrency/unguarded-access", path, line, col,
+                "`self.%s` is guarded by `%s` (%s) but %s here without it "
+                "on a path reachable from a thread entry point"
+                % (attr, owner,
+                   "declared" if attr in ci.explicit else "inferred",
+                   "written" if kind == "write" else "read")))
+        # blocking under lock
+        for line, col, desc, held in m.blocking:
+            if held | base:
+                state.add(Finding(
+                    "concurrency/blocking-under-lock", path, line, col,
+                    "%s while holding %s — convoy risk: every thread "
+                    "contending for the lock stalls behind it"
+                    % (desc, ", ".join(sorted(_tok_str(t)
+                                              for t in held | base)))))
+        # re-acquiring a non-reentrant Lock
+        for attr, line, col, held_before in m.withs:
+            if attr in (held_before | base) and ci.locks.get(attr) == "Lock":
+                state.add(Finding(
+                    "concurrency/lock-order", path, line, col,
+                    "re-acquiring non-reentrant Lock `self.%s` already "
+                    "held on this path — guaranteed deadlock" % attr))
+
+
+def _check_spawns(state: _State, ci: _ClassInfo) -> None:
+    """Orphan daemon threads — checked for EVERY class, locks or not."""
+    for m in ci.methods.values():
+        for line, col, target in m.spawns:
+            if ci.events and (ci.events & ci.event_set_calls):
+                continue
+            if target is not None:
+                ra = _root_self_attr(target)
+                if ra is not None and ra in ci.shutdown_attrs:
+                    continue
+            state.add(Finding(
+                "concurrency/orphan-daemon-thread", ci.module.path, line,
+                col,
+                "daemon thread spawned by %s.%s with no registered stop "
+                "Event — it cannot be shut down cleanly; add a "
+                "threading.Event the loop checks and set() it in "
+                "close()/stop()" % (ci.name, m.name)))
+
+
+def _check_module_level_spawns(state: _State, module: SourceModule,
+                               cg, mi) -> None:
+    """Daemon threads spawned outside any class: the enclosing function
+    (or module) must own an Event that something set()s."""
+    events: Set[str] = set()
+    sets: Set[str] = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if cg.resolve_dotted(mi, node.value.func) == _EVENT_TYPE:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        events.add(t.id)
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "set"
+                and isinstance(node.func.value, ast.Name)):
+            sets.add(node.func.value.id)
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Call)
+                and cg.resolve_dotted(mi, node.func) == "threading.Thread"):
+            continue
+        in_class = any(isinstance(a, ast.ClassDef)
+                       for a in module.ancestors(node))
+        if in_class:
+            continue
+        daemon = any(kw.arg == "daemon"
+                     and isinstance(kw.value, ast.Constant)
+                     and kw.value.value is True for kw in node.keywords)
+        if daemon and not (events & sets):
+            state.add(Finding(
+                "concurrency/orphan-daemon-thread", module.path,
+                node.lineno, node.col_offset + 1,
+                "daemon thread spawned with no stop Event in scope — add "
+                "a threading.Event the loop checks and set() it on "
+                "shutdown"))
+
+
+def analyze(ctx) -> _State:
+    """Run the whole-tree concurrency analysis once; cached on the
+    LintContext so per-module ``check`` calls share it."""
+    cached = getattr(ctx, "_concurrency_state", None)
+    if cached is not None:
+        return cached
+    state = _State()
+    cg = ctx.callgraph
+    for module in ctx.modules:
+        mi = cg.module_info(module)
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                ci = _ClassInfo(module, node)
+                _ClassScanner(ci, cg, mi).scan()
+                state.classes[ci.key()] = ci
+        _check_module_level_spawns(state, module, cg, mi)
+    _merge_bases(state)
+    for ci in state.classes.values():
+        if not ci.locks:
+            continue
+        _fix_entry_sets(ci)
+        _infer_guarded(ci)
+    # a subclass inherits the base's ownership map: an attribute the base
+    # guards stays guarded even when the subclass's own call sites break
+    # the discipline (that breakage is exactly what we want to flag)
+    for ci in state.classes.values():
+        for base in ci.bases:
+            bci = state.classes.get((ci.module.name, base))
+            if bci is None:
+                continue
+            for attr, lock in bci.guarded.items():
+                if attr not in ci.optout:
+                    ci.guarded.setdefault(attr, lock)
+    acq = _transitive_acquires(state)
+    _build_edges(state, acq)
+    for ci in state.classes.values():
+        _check_spawns(state, ci)
+        if not ci.locks:
+            continue
+        _check_class(state, ci)
+    _find_cycles(state)
+    # base-merged subclasses re-analyze inherited methods: dedupe by site
+    for path, fs in state.findings.items():
+        seen = set()
+        out = []
+        for f in sorted(fs, key=lambda f: (f.line, f.col, f.rule)):
+            k = (f.rule, f.line, f.col, f.message)
+            if k not in seen:
+                seen.add(k)
+                out.append(f)
+        state.findings[path] = out
+    ctx._concurrency_state = state
+    return state
+
+
+def check(module: SourceModule, ctx) -> List[Finding]:
+    state = analyze(ctx)
+    return list(state.findings.get(module.path, []))
+
+
+def render_lock_graph(ctx) -> str:
+    """Markdown tables for ``--lock-graph``: per-class ownership map plus
+    the acquisition-order edges (the README's concurrency section embeds
+    this output)."""
+    state = analyze(ctx)
+    lines: List[str] = ["| class | lock | kind | guards |",
+                        "|---|---|---|---|"]
+    for key in sorted(state.classes):
+        ci = state.classes[key]
+        if not ci.locks:
+            continue
+        by_lock: Dict[str, List[str]] = {}
+        for attr, lock in sorted(ci.guarded.items()):
+            by_lock.setdefault(lock, []).append(attr)
+        for lock, kind in sorted(ci.locks.items()):
+            if ci.lock_definer.get(lock, ci.name) != ci.name:
+                continue  # inherited: listed under the defining class
+            lines.append("| %s | %s | %s | %s |" % (
+                ci.name, lock, kind,
+                ", ".join(by_lock.get(lock, [])) or "—"))
+    lines.append("")
+    lines.append("Acquisition order (acquire left before right):")
+    lines.append("")
+    if state.edges:
+        for (a, b) in sorted(state.edges):
+            path, line = state.edges[(a, b)]
+            lines.append("- `%s` -> `%s`  (%s:%d)" % (a, b, path, line))
+    else:
+        lines.append("- (no nested acquisitions)")
+    return "\n".join(lines)
