@@ -2,7 +2,8 @@
 # run by default; `make test` is the tier-1 suite (which embeds the same
 # lint gate via tests/test_kubelint.py).  `make help` lists everything.
 
-.PHONY: help lint lock-graph test sanitize-test race-test bench
+.PHONY: help lint lock-graph test sanitize-test race-test flight-test \
+	trace bench
 
 help:
 	@echo "kubetpu targets:"
@@ -17,6 +18,12 @@ help:
 	@echo "  make race-test      8-thread stress + seeded-violation tests under"
 	@echo "                      KUBETPU_RACE=1 (instrumented locks, lock-order"
 	@echo "                      + hold-time enforcement, guarded-attr checks)"
+	@echo "  make flight-test    flight recorder + decision audit suite (ring"
+	@echo "                      wrap/drops, Chrome-trace schema, /debug"
+	@echo "                      endpoints, disarmed no-op)"
+	@echo "  make trace          run the pipelined drain with the flight"
+	@echo "                      recorder armed, write PIPELINE_TRACE.json +"
+	@echo "                      .perfetto.json, print the text flame summary"
 	@echo "  make bench          end-to-end throughput benchmark (bench.py;"
 	@echo "                      BENCH_OUT=<path> writes the JSON atomically)"
 
@@ -41,6 +48,18 @@ sanitize-test:
 race-test:
 	JAX_PLATFORMS=cpu KUBETPU_RACE=1 python -m pytest \
 		tests/test_racecheck.py -q -p no:cacheprovider
+
+# flight recorder + per-pod decision audit (utils/trace.py,
+# utils/decisions.py, /debug/flightz + /debug/explain)
+flight-test:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_flightrecorder.py -q -p no:cacheprovider
+
+# pipelined-drain trace via the flight recorder + text flame summary
+# (PIPELINE_TRACE.json + PIPELINE_TRACE.perfetto.json for ui.perfetto.dev)
+trace:
+	python tools/trace_pipeline.py
+	python tools/traceview.py PIPELINE_TRACE.json
 
 bench:
 	python bench.py

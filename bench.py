@@ -33,6 +33,13 @@ distinguishable from tunnel variance.  BENCH_OUT=<path> additionally
 writes {"headline", "detail"} to that path ATOMICALLY (tempfile + fsync +
 os.replace; see atomic_write_json) so a timeout mid-run can never commit
 a truncated document.
+
+The cycle FLIGHT RECORDER (kubetpu/utils/trace.py) is armed for the whole
+run; the headline mode's span trees are committed as PIPELINE_TRACE.json
+(flat span list, span_total) and PIPELINE_TRACE.perfetto.json (Chrome
+traceEvents, loadable in ui.perfetto.dev — its ph:"X" count equals
+span_total).  `make trace` / tools/traceview.py render the text flame
+summary.
 """
 
 from __future__ import annotations
@@ -555,6 +562,13 @@ def main() -> None:
     enable_persistent_cache()
     import jax
 
+    # the flight recorder rides every bench cycle (its < 2% overhead is
+    # part of the measured number — serving runs it too); the headline
+    # mode's ring is exported as PIPELINE_TRACE.json + the
+    # Perfetto-loadable PIPELINE_TRACE.perfetto.json below
+    from kubetpu.utils import trace as utrace
+    flight = utrace.arm_flight_recorder()
+
     detail = {"backend": jax.default_backend(), "pending": n_pods,
               "nodes": n_nodes}
     # warm-restart SLO FIRST: this process has run no jit yet, so the
@@ -565,7 +579,11 @@ def main() -> None:
         except Exception as e:  # pragma: no cover
             detail["warm_restart"] = {"error": repr(e)}
     headline = None
+    trace_doc = chrome_doc = None
     for mode in modes:
+        if headline is None:
+            # the exported trace covers exactly the headline mode's cycles
+            flight.clear()
         best, first, outcomes, sched, stats = run_mode(
             mode, n_nodes, n_pods, existing_per_node, repeats,
             mesh_shape=mesh_shape)
@@ -575,6 +593,11 @@ def main() -> None:
         sched.close()
         if headline is None:
             headline = (mode, pods_per_sec)
+            trace_doc = flight.to_pipeline_doc(
+                workload=f"{mode} {n_pods} pods x {n_nodes} nodes, "
+                         f"{repeats + 1} attempts (flight recorder, last "
+                         f"{flight.capacity} cycles)")
+            chrome_doc = flight.to_chrome_trace()
 
     # the headline prints BEFORE the optional extra cases: a failure at an
     # experimental scale must never cost the recorded number
@@ -592,6 +615,15 @@ def main() -> None:
         "spread": hl.get("spread", {}),
     }
     print(json.dumps(headline_doc), flush=True)
+
+    # PIPELINE_TRACE.json now comes FROM the flight recorder (the same
+    # span trees /debug/flightz serves), with a Perfetto-loadable Chrome
+    # trace-event twin whose ph:"X" event count equals span_total —
+    # `python tools/traceview.py PIPELINE_TRACE.json` prints the flame
+    # summary
+    if trace_doc is not None:
+        atomic_write_json("PIPELINE_TRACE.json", trace_doc)
+        atomic_write_json("PIPELINE_TRACE.perfetto.json", chrome_doc)
 
     if os.environ.get("BENCH_CHAIN_DRAIN", "1") == "1" and mesh_shape is None:
         try:

@@ -7,13 +7,19 @@ Importing the package arms the opt-in runtime harnesses:
   compile-count watchdog;
 * ``KUBETPU_RACE=1`` (utils/racecheck.py): instrumented locks (order +
   hold-time enforcement) and guarded-attribute mutation checks for the
-  threaded host path.
+  threaded host path;
+* ``KUBETPU_FLIGHT=1`` (utils/trace.py): the cycle flight recorder — a
+  ring buffer of the last ``KUBETPU_FLIGHT_N`` scheduling cycles' span
+  trees, dumped by ``/debug/flightz`` and exportable as Perfetto/Chrome
+  trace-event JSON.
 
 Off (the default) this import touches nothing and does not import jax.
 """
 
 from .utils.racecheck import maybe_enable_from_env as _maybe_racecheck
 from .utils.sanitize import maybe_enable_from_env as _maybe_sanitize
+from .utils.trace import maybe_arm_from_env as _maybe_flight
 
 _maybe_sanitize()
 _maybe_racecheck()
+_maybe_flight()

@@ -25,6 +25,7 @@ from ..api import types as api
 from ..apis.config import KubeSchedulerConfiguration, KubeSchedulerProfile
 from ..client.store import ClusterStore
 from ..scheduler import Scheduler
+from ..utils import trace as _utrace
 from ..utils.metrics import SchedulerMetrics
 from . import hollow
 
@@ -312,6 +313,15 @@ def run_workload(w: Workload, verbose: bool = False) -> List[DataItem]:
                      unit="mixed",
                      labels={"Name": w.name, "Metric": "SchedulerStats"}),
         ]
+        fr = _utrace.flight_recorder()
+        if fr is not None:
+            # flight-recorder health next to the perf numbers: how many of
+            # the run's cycles the ring still holds and how many it shed
+            items.append(DataItem(
+                data={"Cycles": float(len(fr.cycles())),
+                      "Dropped": float(fr.dropped())},
+                unit="count",
+                labels={"Name": w.name, "Metric": "FlightRecorder"}))
         for metric, hist in (
                 ("scheduling_algorithm_duration_seconds",
                  metrics.scheduling_algorithm_duration),

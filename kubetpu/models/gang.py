@@ -394,6 +394,15 @@ def _schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
         feas0=jnp.zeros((B, N), bool),
         unres=static_unres,
         rounds=jnp.int32(0),
+        # rounds that ADMITTED >= 1 pod: the windowed loop's budget.
+        # Retire-only rounds must not consume it — with many permanently-
+        # infeasible low-index pods the admit/retire alternation can take
+        # far more than B total rounds while making real progress, and
+        # charging those rounds against max_rounds starved still-feasible
+        # pods into spurious preemption_may_help failures (ADVICE r5).
+        # Admission rounds are intrinsically <= B (each assigns >= 1 pod),
+        # so the budget keeps its original meaning.
+        admits=jnp.int32(0),
         progress=jnp.bool_(True),
         # windowed-residual bookkeeping: pods proven infeasible in a round
         # with no admission leave the selection pool until an admission
@@ -674,6 +683,7 @@ def _schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
                                          c["unres"])
         admitted_any = jnp.any(admit)
         new["rounds"] = c["rounds"] + 1
+        new["admits"] = c["admits"] + admitted_any.astype(jnp.int32)
         if windowed:
             # retirement: a pod with NO feasible node in a no-admission
             # round leaves the window-selection pool; any admission
@@ -714,9 +724,14 @@ def _schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
         out = round_step(carry0, fsb, capture_first=True, windowed=True)
 
         def condw(c):
+            # budget on ADMISSION rounds, not total rounds: retire-only
+            # rounds are free (progress still gates them — a round that
+            # neither admits nor newly retires ends the loop), so feasible
+            # pods behind a long infeasible tail cannot be starved by the
+            # admit/retire alternation burning the shared budget
             pool = (c["assigned"] < 0) & batch.valid & ~c["retired"]
             return (c["progress"] & jnp.any(pool)
-                    & (c["rounds"] < max_rounds))
+                    & (c["admits"] < max_rounds))
 
         def bodyw(c):
             pool = (c["assigned"] < 0) & batch.valid & ~c["retired"]

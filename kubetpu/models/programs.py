@@ -181,6 +181,70 @@ def explain_filters(cluster, batch, cfg: ProgramConfig, host_ok=None):
     return no_feasible, jnp.stack(blocking)
 
 
+# best_score is shipped in integer MILLI-units so the whole audit packs
+# into ONE i32 array (one tunnel transfer); the host divides back.
+# Milli, not micro: default-profile totals reach ~1e6 per node
+# (NodePreferAvoidPods weight 10000 x MAX_NODE_SCORE 100), which already
+# overflows i32 at micro scale — and the cast clips as a second fence.
+SCORE_SCALE = 1_000
+_SCORE_I32_MAX = float(2**31 - 128)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def explain_verdicts(cluster, batch, cfg: ProgramConfig, host_ok=None):
+    """The per-pod decision audit program (DecisionLog feed): everything
+    the host needs to say WHY a pod was (un)schedulable this cycle, in
+    ONE packed [2F + 3, B] i32 readback (F = len(cfg.filters)):
+
+      rows 0..F-1      per-filter FAILED-NODE counts ("412 nodes failed
+                       NodeResourcesFit") over valid nodes passing host_ok
+      rows F..2F-1     0/1 blocking flags (explain_filters semantics: every
+                       node passing all OTHER filters fails this one)
+      row 2F           0/1 no-feasible-node flag
+      row 2F + 1       best feasible node row (-1 when none) — argmax of
+                       the weighted score over the feasible mask
+      row 2F + 2       best feasible score in milli-units (SCORE_SCALE,
+                       clipped to the i32 range)
+
+    Evaluated against the cycle-start snapshot (same state the dispatch
+    filtered), so a gang pod that lost purely to intra-batch contention
+    reports its round-0 feasible count and best score."""
+    from .batch import densify_for
+    batch = densify_for(cluster, batch)
+    base = cluster.node_valid[None, :] & batch.valid[:, None]
+    if host_ok is not None:
+        base = base & host_ok
+    affinity_ok = K.node_affinity_filter(cluster, batch)
+    masks = [
+        _filter_mask(name, cluster, batch, cfg, affinity_ok)[0] & base
+        for name in cfg.filters]
+    all_ok = base
+    for m in masks:
+        all_ok = all_ok & m
+    no_feasible = ~jnp.any(all_ok, axis=1) & batch.valid
+    fail_counts = [jnp.sum((base & ~m).astype(jnp.int32), axis=1)
+                   for m in masks]
+    blocking = []
+    for i in range(len(masks)):
+        others = base
+        for j, m in enumerate(masks):
+            if j != i:
+                others = others & m
+        blocked = jnp.any(others, axis=1) & ~jnp.any(others & masks[i], axis=1)
+        blocking.append((blocked & no_feasible).astype(jnp.int32))
+    scores, _ = run_scores(cluster, batch, cfg, all_ok, affinity_ok)
+    neg = jnp.float32(-2**30)
+    masked = jnp.where(all_ok, scores, neg)
+    any_ok = jnp.any(all_ok, axis=1)
+    best_node = jnp.where(any_ok, jnp.argmax(masked, axis=1), -1)
+    best_score = jnp.where(any_ok, jnp.max(masked, axis=1), 0.0)
+    return jnp.stack(fail_counts + blocking + [
+        no_feasible.astype(jnp.int32),
+        best_node.astype(jnp.int32),
+        jnp.clip(jnp.round(best_score * SCORE_SCALE),
+                 -_SCORE_I32_MAX, _SCORE_I32_MAX).astype(jnp.int32)])
+
+
 STATIC_RAW_SCORES = {
     # score plugins whose RAW scores are independent of the auction carry
     # (requested usage and intra-batch placements): gang mode computes them

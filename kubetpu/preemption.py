@@ -54,6 +54,7 @@ of inside every reprieve step.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
@@ -67,6 +68,7 @@ from .models.batch import PodBatchBuilder
 from .state.tensors import (MIB, CH_PODS, SnapshotBuilder,
                             resource_to_channels)
 from .utils.intern import pow2_bucket
+from .utils.trace import flight_span
 
 
 class Victims:
@@ -869,11 +871,19 @@ class Preemptor:
             if f not in ("PodTopologySpread", "InterPodAffinity")))
         cluster = cycle.cluster_now()
         static_ok = programs.whatif_static_ok(cluster, batch, cfg_w)
-        packed = np.asarray(programs.whatif_wave(
-            cluster, static_ok, jnp.asarray(np.asarray(batch.req)),
-            jnp.asarray(cand_rows), jnp.asarray(cand_valid), nom_dev,
-            jnp.asarray(tab_req), jnp.asarray(tab_valid),
-            jnp.asarray(cand_idx)))   # ONE readback for the whole wave
+        # flight_span attaches under the scheduler's open preemption-wave
+        # span (utils/trace.py) — no-op when the recorder is disarmed
+        with flight_span("whatif-readback", pods=B) as sp:
+            t_dev = time.time()
+            packed = np.asarray(programs.whatif_wave(
+                cluster, static_ok, jnp.asarray(np.asarray(batch.req)),
+                jnp.asarray(cand_rows), jnp.asarray(cand_valid), nom_dev,
+                jnp.asarray(tab_req), jnp.asarray(tab_valid),
+                jnp.asarray(cand_idx)))   # ONE readback for the whole wave
+            if sp is not None:
+                # wave device-wait attribution (the what-if dispatch +
+                # transfer is the wave's only device sync)
+                sp.args["device_wait_s"] = round(time.time() - t_dev, 6)
 
         # pickOneNode metrics, vectorized over the whole [B, C, K] block
         # (generic_scheduler.go:729 criteria 1-5; criterion 6 = first in

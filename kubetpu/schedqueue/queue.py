@@ -401,6 +401,15 @@ class SchedulingQueue(PodNominator):
 
     # -- introspection ------------------------------------------------------
 
+    def depths(self) -> Dict[str, int]:
+        """Per-queue depths in one locked read — the flight recorder
+        stamps these on each cycle record at cycle start (the serving
+        loop only calls this when the recorder is armed)."""
+        with self._cond:
+            return {"active": len(self.active_q),
+                    "backoff": len(self.backoff_q),
+                    "unschedulable": len(self.unschedulable_q)}
+
     def pending_pods(self) -> List[api.Pod]:
         """reference: :601 PendingPods."""
         with self._cond:

@@ -44,6 +44,8 @@ from .plugins.intree import new_in_tree_registry
 from .schedqueue.queue import SchedulingQueue
 from .state.cache import SchedulerCache, Snapshot
 from .state.tensors import SnapshotBuilder
+from .utils import trace as utrace
+from .utils.decisions import DecisionLog, PodDecision
 from .utils.trace import Trace
 
 
@@ -86,6 +88,9 @@ class PreparedCycle:
     used_chain: bool = False
     chain_pod_uids: list = field(default_factory=list)
     score_bias: object = None   # [B, N] weighted host Score plugin totals
+    # per-pod host-filter rejection reasons (uid -> reason -> node count),
+    # folded into the DecisionLog by the commit-path audit
+    host_reject: Dict[str, Dict[str, int]] = field(default_factory=dict)
 
 
 class Scheduler:
@@ -180,6 +185,16 @@ class Scheduler:
         # cumulative analytic device FLOPs (utils/flops.py; gang mode only)
         self.device_flops = 0.0
         self._async_binding = async_binding
+        # per-pod decision audit (utils/decisions.py): bounded, on by
+        # default, disabled with KUBETPU_AUDIT=0 — disabled, no commit
+        # path takes its lock
+        self.decisions = DecisionLog()
+        # flight-recorder drop count already folded into the metrics
+        # counter (serving thread only)
+        self._flight_dropped_seen = 0
+        # (failed-uid set, audit rows) of the last decision audit — the
+        # retry-churn dedup in _commit_group (serving thread only)
+        self._audit_cache = None
         # pipelined drain: the dispatched-but-uncommitted cycle (prep, res)
         self._inflight_cycle = None
         # (pod-axis bucket, compile-or-load seconds) per prewarmed program
@@ -421,29 +436,41 @@ class Scheduler:
                 # would duplicate events and preemption attempts.
                 returned += self._finish_group(*prev)
                 prev = None
+                stale = prep.trace
                 prep, early2 = self._prepare_group(fwk, prep.live)
+                stale.finish(discarded=True)
                 early += early2
                 if prep is None:
                     return returned + early
             # readback k-1 BEFORE dispatching k (FIFO tunnel), then
             # dispatch k, then run k-1's commit loop while k executes
             packed_prev = self._readback_group(*prev) if prev else None
-            res = self._dispatch_group(
-                prep, extra_uncommitted=(prev[0].batch.valid.shape[0]
-                                         if prev else 0))
+            with prep.trace.stage("dispatch",
+                                  pipelined=prev is not None):
+                res = self._dispatch_group(
+                    prep, extra_uncommitted=(prev[0].batch.valid.shape[0]
+                                             if prev else 0))
             self._last_commit_failed = False
-            outcomes = (self._commit_group(prev[0], packed_prev)
-                        if prev else [])
+            if prev is not None:
+                with prev[0].trace.stage("commit"):
+                    outcomes = self._commit_group(prev[0], packed_prev)
+                prev[0].trace.finish()
+                self._sync_flight_dropped()
+            else:
+                outcomes = []
             if prep.used_chain and self._last_commit_failed:
                 # committing k-1 failed: this cycle was dispatched against
                 # a chain whose placements never materialized.  Discard
                 # and re-run synchronously over the surviving pods only
                 # (already-failed pods' outcomes in `early` are final)
+                stale = prep.trace
                 prep, early2 = self._prepare_group(fwk, prep.live)
+                stale.finish(discarded=True)
                 early += early2
                 if prep is None:
                     return returned + outcomes + early
-                res = self._dispatch_group(prep)
+                with prep.trace.stage("dispatch"):
+                    res = self._dispatch_group(prep)
             self._inflight_cycle = (prep, res)
             returned += outcomes + early
             if returned:
@@ -494,18 +521,28 @@ class Scheduler:
         if prep is None:
             return outcomes
         if self.extenders:
-            return outcomes + self._schedule_with_extenders(
-                fwk, prep.live, prep.states, prep.node_infos, prep.cluster,
-                prep.batch, prep.cfg, prep.host_ok_dev, prep.cycle_ctx)
-        res = self._dispatch_group(prep)
+            try:
+                return outcomes + self._schedule_with_extenders(
+                    fwk, prep.live, prep.states, prep.node_infos,
+                    prep.cluster, prep.batch, prep.cfg, prep.host_ok_dev,
+                    prep.cycle_ctx, score_bias=prep.score_bias)
+            finally:
+                prep.trace.finish()
+        with prep.trace.stage("dispatch"):
+            res = self._dispatch_group(prep)
         return outcomes + self._finish_group(prep, res)
 
     def _prepare_group(self, fwk: Framework, qpods: List[QueuedPodInfo]):
         """Host half of a cycle, up to (but excluding) the device dispatch:
         snapshot, PreFilter, tensorize-or-chain, host filter masks,
         nominated overlay.  Returns (PreparedCycle | None, early outcomes)."""
+        # queue depths ride the cycle record; the read takes the queue's
+        # condition lock, so it is GATED on the recorder being armed (the
+        # disarmed hot path must take no new locks)
+        depths = (self.queue.depths()
+                  if utrace.flight_recorder() is not None else None)
         trace = Trace("Scheduling", profile=fwk.profile_name,
-                      pods=len(qpods))
+                      pods=len(qpods), queue_depths=depths)
         # capture the event sequence BEFORE snapshotting: a chain is only
         # reusable if no event has landed since the state it embeds
         with self._chain_lock:
@@ -533,16 +570,24 @@ class Scheduler:
                                            st.message() or "prefilter failed",
                                            preemption_may_help=not st.code
                                            == Code.UNSCHEDULABLE_AND_UNRESOLVABLE))
+                self._record_decision(qp.pod, "unschedulable",
+                                      message=st.message()
+                                      or "prefilter failed",
+                                      blocking=["PreFilter"])
                 continue
             states[qp.pod.uid] = state
             live.append(qp)
         if not live:
+            trace.finish()
             return None, outcomes
         if n_nodes == 0:
             for qp in live:
                 outcomes.append(self._fail(fwk, qp, states[qp.pod.uid], "",
                                            "0/0 nodes are available",
                                            preemption_may_help=False))
+                self._record_decision(qp.pod, "unschedulable",
+                                      message="0/0 nodes are available")
+            trace.finish()
             return None, outcomes
 
         # ---- tensorize, or reuse the CHAINED cluster: the previous gang
@@ -625,6 +670,8 @@ class Scheduler:
                 vol_mask_dev = volume_mask(cluster, overlay)
         host_ok = np.ones((B, N), bool)
         any_host = False
+        host_reject: Dict[str, Dict[str, int]] = {}
+        audit = self.decisions.enabled
         for i, qp in enumerate(live):
             if not host_relevant[qp.pod.uid]:
                 continue
@@ -635,6 +682,12 @@ class Scheduler:
             for j, ni in enumerate(node_infos):
                 st = fwk.run_filter_plugins(state, qp.pod, ni)
                 host_ok[i, j] = st.is_success()
+                if audit and not st.is_success():
+                    # per-reason node counts for the decision audit
+                    # ("4 nodes rejected by host filter: too many volumes")
+                    counts = host_reject.setdefault(qp.pod.uid, {})
+                    for r in (st.reasons or ["host filter failed"]):
+                        counts[r] = counts.get(r, 0) + 1
         # ---- nominated-pods two-pass overlay (addNominatedPods,
         # generic_scheduler.go:530,594-612): equal/higher-priority pods
         # nominated by preemption reserve their nominated nodes' capacity
@@ -737,7 +790,7 @@ class Scheduler:
             host_relevant=host_relevant, host_ok_dev=host_ok_dev, cfg=cfg,
             cycle_ctx=cycle_ctx, needs_topo=needs_topo,
             used_chain=use_chain, chain_pod_uids=chain_pod_uids,
-            score_bias=score_bias)
+            score_bias=score_bias, host_reject=host_reject)
         return prep, outcomes
 
     def _dispatch_group(self, prep: PreparedCycle, extra_uncommitted: int = 0):
@@ -853,7 +906,12 @@ class Scheduler:
     def _finish_group(self, prep: PreparedCycle, res) -> List[ScheduleOutcome]:
         """Readback + commit half of a cycle.  The packed readback is the
         cycle's ONLY device->host sync point."""
-        return self._commit_group(prep, self._readback_group(prep, res))
+        packed = self._readback_group(prep, res)
+        with prep.trace.stage("commit"):
+            out = self._commit_group(prep, packed)
+        prep.trace.finish()
+        self._sync_flight_dropped()
+        return out
 
     def _readback_group(self, prep: PreparedCycle, res) -> np.ndarray:
         """ONE device->host readback per cycle: the packed [3B+1] i32 view
@@ -863,9 +921,15 @@ class Scheduler:
         BEFORE dispatching the next cycle; everything the host needs rides
         one small array — the big tensors (requested, masks) stay on
         device for chaining / lazy preemption verdicts."""
-        t_dev = time.time()
-        packed = np.asarray(res.packed)
-        self.device_wait_s += time.time() - t_dev
+        with prep.trace.stage("packed-readback") as sp:
+            t_dev = time.time()
+            packed = np.asarray(res.packed)
+            wait = time.time() - t_dev
+            if sp is not None:
+                # per-span device-wait attribution: the readback is the
+                # cycle's only observable device sync
+                sp.args["device_wait_s"] = round(wait, 6)
+        self.device_wait_s += wait
         return packed
 
     def _commit_group(self, prep: PreparedCycle,
@@ -900,6 +964,8 @@ class Scheduler:
         # cost one [B, N] pass, not N)
         deferred = []  # (outcome index, qp, state, message, may_help)
         commit_failed = False
+        audit = self.decisions.enabled
+        flight = trace.rec
         for i, qp in enumerate(live):
             state = states[qp.pod.uid]
             if chosen[i] < 0:
@@ -911,13 +977,23 @@ class Scheduler:
             node_name = node_infos[chosen[i]].node_name
             outcome = self._commit(fwk, qp, state, node_name,
                                    n_feas[i], pinfo=pinfos[i],
-                                   host_relevant=prep.host_relevant[qp.pod.uid])
+                                   host_relevant=prep.host_relevant[qp.pod.uid],
+                                   flight=flight)
             if outcome.node:
                 # preemption for pods failing later in this batch must see
                 # this placement (CycleContext.cluster_now overlay)
                 cycle_ctx.note_commit(i, chosen[i])
+                if audit:
+                    self._record_decision(qp.pod, "scheduled",
+                                          node=outcome.node,
+                                          n_feasible=n_feas[i])
             else:
                 commit_failed = True
+                if audit:
+                    self._record_decision(qp.pod, "unschedulable",
+                                          message=outcome.err or
+                                          "commit failed",
+                                          n_feasible=n_feas[i])
             outcomes.append(outcome)
         # ---- preemption WAVE: every preemption-eligible FitError of this
         # cycle is served by ONE batched what-if (preemption.preempt_wave)
@@ -933,12 +1009,38 @@ class Scheduler:
             pf = fwk.post_filter_plugins
             if pf and isinstance(pf[0], DefaultPreemption):
                 try:
-                    self.preemptor.preempt_wave(fwk, cycle_ctx, wave_pods)
+                    with trace.stage("preemption-wave",
+                                     pods=len(wave_pods)):
+                        self.preemptor.preempt_wave(fwk, cycle_ctx,
+                                                    wave_pods)
                 except Exception:
                     import logging
                     logging.getLogger("kubetpu").warning(
                         "preemption wave failed; per-pod fallback",
                         exc_info=True)
+        # ---- decision audit: fold the per-(pod, node) filter verdicts
+        # already computed on device into per-plugin attribution for the
+        # failed pods (one extra packed readback, only on cycles that have
+        # failures and only with the audit enabled)
+        audit_rows = {}
+        if deferred and audit:
+            # retry-churn dedup: a persistent unschedulable tail fails
+            # with the SAME pod set against the SAME state every cycle —
+            # re-dispatching the audit would add a device sync per cycle
+            # (and, pipelined, serialize behind the in-flight dispatch)
+            # for identical answers.  Reuse holds only when nothing
+            # placed, nothing evicted and no preemption wave ran this
+            # cycle; any success or wave recomputes.
+            uids = frozenset(qp.pod.uid for _, qp, _, _, _ in deferred)
+            cached = self._audit_cache
+            if (cached is not None and cached[0] == uids
+                    and cycle_ctx.commits == 0 and not wave_pods):
+                audit_rows = cached[1]
+            else:
+                with trace.stage("decision-audit", pods=len(deferred)):
+                    audit_rows = self._audit_failures(
+                        prep, [qp for _, qp, _, _, _ in deferred])
+                self._audit_cache = (uids, audit_rows)
         # pod_verdicts refreshes the shared verdicts lazily on the FIRST
         # preemption attempt that needs them (and the min-priority gate may
         # skip them entirely), so no eager refresh here
@@ -946,6 +1048,13 @@ class Scheduler:
             outcomes[idx] = self._fail(fwk, qp, state, "", msg,
                                        preemption_may_help=mh,
                                        cycle=cycle_ctx)
+            if audit:
+                info = audit_rows.get(qp.pod.uid, {})
+                self._record_decision(
+                    qp.pod, "unschedulable", message=msg,
+                    nominated_node=qp.pod.status.nominated_node_name or "",
+                    host_reasons=prep.host_reject.get(qp.pod.uid),
+                    **info)
         # a commit-path failure invalidates the speculative chain (and any
         # later cycle already dispatched against it — the pipelined drain
         # reads _last_commit_failed and re-runs that cycle)
@@ -957,13 +1066,33 @@ class Scheduler:
         trace.log_if_long()
         return outcomes
 
+    def _sync_flight_dropped(self) -> None:
+        """Fold new flight-recorder ring drops into the monotonic metric
+        counter — called right after each cycle record commits (serving
+        thread only, so the seen-count needs no lock)."""
+        fr = utrace.flight_recorder()
+        if fr is None or self.metrics is None:
+            return
+        dropped = fr.dropped()
+        if dropped > self._flight_dropped_seen:
+            self.metrics.flight_recorder_dropped.inc(
+                amount=dropped - self._flight_dropped_seen)
+        if dropped != self._flight_dropped_seen:
+            # < happens when the ring was cleared/re-armed mid-run
+            self._flight_dropped_seen = dropped
+
     def _schedule_with_extenders(self, fwk: Framework, live, states,
                                  node_infos, cluster, batch, cfg,
-                                 host_ok, cycle_ctx=None) -> List[ScheduleOutcome]:
+                                 host_ok, cycle_ctx=None,
+                                 score_bias=None) -> List[ScheduleOutcome]:
         """Extender path (reference: generic_scheduler.go:497
         findNodesThatPassExtenders + :674-706 extender Prioritize combine):
         one batch filter+score on device, then per pod the HTTP webhooks
-        refine feasibility/scores and selection happens host-side."""
+        refine feasibility/scores and selection happens host-side.
+        score_bias: the [B, N] weighted host Score plugin totals from
+        _prepare_group — added to the device totals BEFORE the extender
+        Prioritize combine, so host Score plugins are honored identically
+        with and without extenders configured."""
         from .extender import MAX_EXTENDER_PRIORITY, ExtenderError
         import random
         if self._mesh is not None:
@@ -980,7 +1109,10 @@ class Scheduler:
         # box B x N numpy scalars (and, pre-np.asarray, would cost one
         # device sync each — the kubelint host-sync/loop-readback trap)
         feasible = np.asarray(res.feasible).tolist()
-        scores = np.asarray(res.scores).tolist()
+        score_arr = np.asarray(res.scores)
+        if score_bias is not None:
+            score_arr = score_arr + np.asarray(score_bias)
+        scores = score_arr.tolist()
         self.cycle_count += 1
         n_nodes = len(node_infos)
         row_of_node = {ni.node_name: j for j, ni in enumerate(node_infos)}
@@ -1001,12 +1133,16 @@ class Scheduler:
                          for j in range(n_nodes) if row_feas[j]}
             exts = [e for e in self.extenders if e.is_interested(qp.pod)]
             err = None
+            ext_info: Dict[str, str] = {}
             try:
                 for e in exts:
+                    before = len(names)
                     names, _ = e.filter(qp.pod, names)
                     # an extender may echo names outside the device-feasible
                     # set (stale cache, typo) — never let those through
                     names = [n for n in names if n in dev_score]
+                    ext_info[e.url_prefix or "extender"] = (
+                        f"filter {before} -> {len(names)} nodes")
                     if not names:
                         break
             except ExtenderError as ex:
@@ -1014,11 +1150,17 @@ class Scheduler:
             if err is not None:
                 outcomes.append(self._fail(fwk, qp, state, "", err,
                                            preemption_may_help=False))
+                self._record_decision(qp.pod, "unschedulable", message=err,
+                                      extenders=ext_info)
                 continue
             if not names:
                 outcomes.append(self._fail(
                     fwk, qp, state, "", f"0/{n_nodes} nodes are available",
                     cycle=cycle_ctx))
+                self._record_decision(
+                    qp.pod, "unschedulable",
+                    message=f"0/{n_nodes} nodes are available",
+                    extenders=ext_info)
                 continue
             combined = {n: 0.0 for n in names}
             try:
@@ -1030,6 +1172,10 @@ class Scheduler:
                 outcomes.append(self._fail(fwk, qp, state, "",
                                            f"extender prioritize failed: {ex}",
                                            preemption_may_help=False))
+                self._record_decision(
+                    qp.pod, "unschedulable",
+                    message=f"extender prioritize failed: {ex}",
+                    extenders=ext_info)
                 continue
             scale = fw.MAX_NODE_SCORE / MAX_EXTENDER_PRIORITY
             totals = {n: dev_score[n] + combined[n] * scale for n in names}
@@ -1047,6 +1193,10 @@ class Scheduler:
                                    binder_override=binder)
             if outcome.node and cycle_ctx is not None:
                 cycle_ctx.note_commit(i, row_of_node[node_name])
+            self._record_decision(
+                qp.pod, "scheduled" if outcome.node else "unschedulable",
+                node=outcome.node, message=outcome.err or "",
+                n_feasible=len(names), extenders=ext_info)
             outcomes.append(outcome)
         return outcomes
 
@@ -1170,7 +1320,8 @@ class Scheduler:
     def _commit(self, fwk: Framework, qp: QueuedPodInfo, state: CycleState,
                 node_name: str, n_feasible: int,
                 binder_override=None, pinfo: Optional[PodInfo] = None,
-                host_relevant: Optional[bool] = None) -> ScheduleOutcome:
+                host_relevant: Optional[bool] = None,
+                flight=None) -> ScheduleOutcome:
         pod = qp.pod
         if host_relevant is None:
             host_relevant = fwk.has_relevant_host_filters(pod)
@@ -1230,13 +1381,13 @@ class Scheduler:
             try:
                 fut = self._bind_pool.submit(self._bind_cycle, fwk, qp,
                                              state, assumed, node_name,
-                                             binder_override)
+                                             binder_override, flight)
             except RuntimeError:
                 # close() raced the serving loop and shut the pool down
                 # mid-cycle: bind synchronously so the placement still
                 # lands instead of panicking the cycle
                 err = self._bind_cycle(fwk, qp, state, assumed, node_name,
-                                       binder_override)
+                                       binder_override, flight)
             else:
                 # prune completed futures so a long-running scheduler
                 # doesn't retain one CycleState + pod copy per pod
@@ -1246,14 +1397,28 @@ class Scheduler:
                 err = None
         else:
             err = self._bind_cycle(fwk, qp, state, assumed, node_name,
-                                   binder_override)
+                                   binder_override, flight)
         return ScheduleOutcome(pod=pod, node=node_name if err is None else "",
                                err=err, n_feasible=n_feasible)
 
     def _bind_cycle(self, fwk: Framework, qp: QueuedPodInfo, state: CycleState,
                     assumed: api.Pod, node_name: str,
-                    binder_override=None) -> Optional[str]:
-        """reference: scheduler.go:628-687."""
+                    binder_override=None, flight=None) -> Optional[str]:
+        """reference: scheduler.go:628-687.  flight: the cycle's
+        CycleRecord — per-pod bind spans land on it from whichever thread
+        runs the bind (capped per record; None when disarmed)."""
+        if flight is not None:
+            with flight.span("bind", pod=qp.pod.metadata.name,
+                             node=node_name):
+                return self._bind_cycle_inner(fwk, qp, state, assumed,
+                                              node_name, binder_override)
+        return self._bind_cycle_inner(fwk, qp, state, assumed, node_name,
+                                      binder_override)
+
+    def _bind_cycle_inner(self, fwk: Framework, qp: QueuedPodInfo,
+                          state: CycleState, assumed: api.Pod,
+                          node_name: str,
+                          binder_override=None) -> Optional[str]:
         pod = qp.pod
         st = fwk.wait_on_permit(pod)
         if not st.is_success():
@@ -1362,6 +1527,71 @@ class Scheduler:
             pass
         if self.metrics:
             self.metrics.pod_unschedulable()
+
+    # ------------------------------------------------------------------ audit
+
+    def _record_decision(self, pod: api.Pod, outcome: str, **kw) -> None:
+        """Fold one pod's (un)scheduling decision into the bounded
+        DecisionLog (no-op with KUBETPU_AUDIT=0 — no lock taken)."""
+        if not self.decisions.enabled:
+            return
+        self.decisions.record(PodDecision(
+            name=pod.metadata.name, namespace=pod.namespace, uid=pod.uid,
+            outcome=outcome, cycle=self.cycle_count, **kw))
+
+    def _audit_failures(self, prep: PreparedCycle, qpods) -> Dict[str, Dict]:
+        """Per-plugin attribution for this cycle's failed pods: ONE
+        explain_verdicts dispatch + ONE packed [2F+3, B] readback against
+        the cycle-start snapshot (models/programs.py).  Like the
+        preemption wave's what-if, this is a SECOND device sync on
+        cycles that have failures — the retry-churn dedup in
+        _commit_group bounds it to cycles whose failed set or committed
+        state actually changed.  Returns uid -> PodDecision kwargs; also
+        bumps scheduler_framework_rejections_total{plugin} for each pod's
+        blocking plugin(s).  Any failure degrades to no attribution — the
+        audit must never fail a cycle."""
+        try:
+            packed = np.asarray(programs.explain_verdicts(
+                prep.cluster, prep.batch, prep.cfg, prep.host_ok_dev))
+        except Exception:
+            import logging
+            logging.getLogger("kubetpu").warning(
+                "decision audit failed; failures recorded unattributed",
+                exc_info=True)
+            return {}
+        filters = prep.cfg.filters
+        F = len(filters)
+        counts = packed[:F].tolist()
+        blocking = packed[F:2 * F].tolist()
+        no_feas = packed[2 * F].tolist()
+        best_node = packed[2 * F + 1].tolist()
+        best_score = packed[2 * F + 2].tolist()
+        node_infos = prep.node_infos
+        out: Dict[str, Dict] = {}
+        for qp in qpods:
+            row = prep.cycle_ctx.row_of.get(qp.pod.uid)
+            if row is None:
+                continue
+            rej = {filters[f]: counts[f][row]
+                   for f in range(F) if counts[f][row]}
+            blk = [filters[f] for f in range(F) if blocking[f][row]]
+            info: Dict[str, object] = {"rejections": rej, "blocking": blk}
+            if not no_feas[row] and best_node[row] >= 0:
+                # feasible at cycle start — lost to in-batch contention;
+                # name the node it would have scored best on
+                info["best_node"] = node_infos[best_node[row]].node_name
+                info["best_score"] = (best_score[row]
+                                      / programs.SCORE_SCALE)
+            if self.metrics is not None:
+                attributed = blk
+                if not attributed and no_feas[row] and rej:
+                    # no single filter blocks alone (joint infeasibility):
+                    # attribute to the one failing the most nodes
+                    attributed = [max(rej, key=rej.get)]
+                for plugin in attributed:
+                    self.metrics.framework_rejections.inc(plugin)
+            out[qp.pod.uid] = info
+        return out
 
     # ------------------------------------------------------------------ loop
 
@@ -1477,8 +1707,13 @@ class Scheduler:
         if self.config.mode == "gang":
             if self._mesh is not None:
                 from .parallel import mesh as pmesh
+                # score_bias=warm_bias like the single-chip branch: mesh
+                # profiles with host score plugins serve the bias-variant
+                # program, so prewarm must compile that variant or the
+                # first real cycle pays the compile stall (ADVICE r5)
                 res = pmesh.sharded_schedule_gang(cluster, batch, cfg, rng,
-                                                  self._mesh)
+                                                  self._mesh,
+                                                  score_bias=warm_bias)
             else:
                 from .models.gang import run_auction
                 res = run_auction(cluster, batch, cfg, rng,
@@ -1488,7 +1723,8 @@ class Scheduler:
             res = pmesh.sharded_schedule_sequential(
                 cluster, batch, cfg, rng,
                 hard_pod_affinity_weight=float(
-                    fwk.hard_pod_affinity_weight))
+                    fwk.hard_pod_affinity_weight),
+                score_bias=warm_bias)
         else:
             res = schedule_sequential(
                 cluster, batch, cfg, rng,
@@ -1496,6 +1732,28 @@ class Scheduler:
                     fwk.hard_pod_affinity_weight),
                 score_bias=warm_bias)
         np.asarray(res.packed)   # wait out the compile
+        if self.decisions.enabled:
+            # the decision-audit program dispatches on the first failing
+            # cycle; compile it HERE so an unschedulable pod cannot stall
+            # the serving loop on the audit's compile (the VERDICT r4 #4
+            # stall class prewarm exists to prevent).  BOTH jit variants:
+            # host_ok=None and the [B, N] array signature _prepare_group
+            # produces whenever host filters / volume masks / nominated
+            # pods are in play.  Serving cycles with a different static
+            # cfg (active_topo_keys) still fall back to the persistent
+            # cache.
+            try:
+                np.asarray(programs.explain_verdicts(cluster, batch, cfg))
+                ones = self._jax.numpy.ones(
+                    (batch.valid.shape[0], cluster.allocatable.shape[0]),
+                    bool)
+                np.asarray(programs.explain_verdicts(cluster, batch, cfg,
+                                                     host_ok=ones))
+            except Exception:
+                import logging
+                logging.getLogger("kubetpu").warning(
+                    "audit prewarm failed; first failing cycle pays the "
+                    "compile", exc_info=True)
         self.prewarm_report.append(
             (int(cluster.pod_valid.shape[0]), round(time.time() - t0, 2)))
         if ladder_steps and self.config.mode == "gang" \
@@ -1531,6 +1789,20 @@ class Scheduler:
             res = run_auction(cluster, batch, cfg, rng,
                               score_bias=warm_bias)
             np.asarray(res.packed)
+            if self.decisions.enabled:
+                # audit program per pod-axis bucket, like the auction (a
+                # drain's failures can land in any grown bucket); both
+                # host_ok variants, matching the base prewarm
+                try:
+                    np.asarray(programs.explain_verdicts(cluster, batch,
+                                                         cfg))
+                    ones = self._jax.numpy.ones(
+                        (batch.valid.shape[0],
+                         cluster.allocatable.shape[0]), bool)
+                    np.asarray(programs.explain_verdicts(
+                        cluster, batch, cfg, host_ok=ones))
+                except Exception:
+                    pass
             self.prewarm_report.append(
                 (int(cluster.pod_valid.shape[0]),
                  round(time.time() - t0, 2)))

@@ -1,17 +1,28 @@
-"""Serving: /healthz, /metrics, /configz endpoints.
+"""Serving: /healthz, /metrics, /configz and the /debug observability
+endpoints.
 
 reference: cmd/kube-scheduler/app/server.go:167-199 (health + metrics
 servers on the secure/insecure ports, configz registration) and
-staging/src/k8s.io/component-base/configz.
+staging/src/k8s.io/component-base/configz.  The /debug family is the
+TPU-native analog of the reference's pprof/debug endpoints
+(DebuggingConfiguration): ``/debug/flightz`` dumps the flight recorder's
+ring (``?format=chrome`` returns Perfetto-loadable Chrome trace-event
+JSON), ``/debug/explain?pod=<name>[&namespace=<ns>]`` answers the per-pod
+"why (un)scheduled" audit from the scheduler's DecisionLog (no pod
+parameter lists the most recent decisions; ``?outcome=unschedulable``
+filters).
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import urllib.parse
 from dataclasses import asdict, is_dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+
+from .utils import trace as utrace
 
 
 class SchedulerServer:
@@ -37,20 +48,74 @@ class SchedulerServer:
                 self.end_headers()
                 self.wfile.write(data)
 
+            def _send_json(self, code: int, doc) -> None:
+                self._send(code, json.dumps(doc, default=str, indent=2),
+                           "application/json")
+
+            def _flightz(self, query) -> None:
+                fr = utrace.flight_recorder()
+                if fr is None:
+                    self._send_json(200, {
+                        "armed": False,
+                        "hint": "arm with KUBETPU_FLIGHT=1 or "
+                                "kubetpu.utils.trace.arm_flight_recorder()"})
+                    return
+                fmt = (query.get("format") or ["json"])[0]
+                if fmt in ("chrome", "perfetto"):
+                    self._send_json(200, fr.to_chrome_trace())
+                else:
+                    self._send_json(200, fr.to_dict())
+
+            def _explain(self, query) -> None:
+                log = getattr(sched, "decisions", None)
+                if log is None or not log.enabled:
+                    self._send_json(200, {
+                        "enabled": False,
+                        "hint": "the decision audit is off "
+                                "(KUBETPU_AUDIT=0)"})
+                    return
+                pod = (query.get("pod") or [None])[0]
+                if not pod:
+                    outcome = (query.get("outcome") or [None])[0]
+                    try:
+                        n = int((query.get("n") or ["50"])[0])
+                    except ValueError:
+                        self._send_json(400, {
+                            "error": "n must be an integer"})
+                        return
+                    self._send_json(200, log.to_dict(n, outcome=outcome))
+                    return
+                ns = (query.get("namespace") or [None])[0]
+                decision = log.get(pod, namespace=ns)
+                if decision is None:
+                    self._send_json(404, {
+                        "error": f"no recorded decision for pod {pod!r}",
+                        "hint": "the DecisionLog is bounded; the pod may "
+                                "not have been attempted yet or its entry "
+                                "was evicted"})
+                    return
+                self._send_json(200, decision.to_dict())
+
             def do_GET(self):
-                if self.path == "/healthz":
+                parsed = urllib.parse.urlparse(self.path)
+                path = parsed.path
+                query = urllib.parse.parse_qs(parsed.query)
+                if path == "/healthz":
                     self._send(200, "ok")
-                elif self.path == "/metrics":
+                elif path == "/metrics":
                     if sched.metrics is None:
                         self._send(200, "")
                     else:
                         self._send(200, sched.metrics.expose_text(),
                                    "text/plain; version=0.0.4")
-                elif self.path == "/configz":
+                elif path == "/configz":
                     cfg = sched.config
                     doc = asdict(cfg) if is_dataclass(cfg) else vars(cfg)
-                    self._send(200, json.dumps(doc, default=str, indent=2),
-                               "application/json")
+                    self._send_json(200, doc)
+                elif path == "/debug/flightz":
+                    self._flightz(query)
+                elif path == "/debug/explain":
+                    self._explain(query)
                 else:
                     self._send(404, "not found")
 
@@ -65,3 +130,6 @@ class SchedulerServer:
         if self._httpd:
             self._httpd.shutdown()
             self._httpd = None
+            if self._thread is not None:
+                self._thread.join(timeout=2.0)
+                self._thread = None

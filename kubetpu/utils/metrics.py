@@ -232,6 +232,16 @@ class SchedulerMetrics:
         self.device_batch_size = r(Histogram(
             f"{p}_device_batch_size", "Pods per device batch.",
             buckets=(1, 8, 32, 128, 512, 2048, 8192)))
+        # observability layer (utils/trace.py flight recorder +
+        # utils/decisions.py audit): per-plugin rejection attribution and
+        # the recorder ring's drop count
+        self.framework_rejections = r(Counter(
+            f"{p}_framework_rejections_total",
+            "Unschedulable pods attributed to the decisive filter plugin "
+            "by the per-pod decision audit.", ("plugin",)))
+        self.flight_recorder_dropped = r(Counter(
+            f"{p}_flight_recorder_dropped_total",
+            "Cycle records dropped by the flight recorder's ring buffer."))
 
     # hooks consumed by queue/scheduler ------------------------------------
 

@@ -82,6 +82,11 @@ class CompileWatchdog(logging.Handler):
             key = (m.group(1), m.group(2))
             with self._lock:
                 self.counts[key] = self.counts.get(key, 0) + 1
+            # feed the flight recorder: a compile landing under a cycle's
+            # open span (dispatch, audit, wave) is exactly the event the
+            # recorder exists to attribute — no-op when disarmed
+            from .trace import note_compile_event
+            note_compile_event(m.group(1), m.group(2))
             return
         if _DONATION_RE.search(msg):
             with self._lock:

@@ -418,3 +418,40 @@ def test_windowed_unschedulable_tail_terminates_quickly():
     assert (chosen >= 0).sum() == 12
     assert (chosen[::4] == -1).all()
     assert int(g.rounds) < 12
+
+
+def test_windowed_retire_rounds_do_not_starve_feasible_pods():
+    """ADVICE r5 (gang.py windowed budget): retire-only rounds must NOT
+    consume the admission budget.  24 permanently-infeasible low-index
+    pods force ~6 retire rounds through a width-4 window after EVERY
+    admission (each admission resets the retired pool), and 8 feasible
+    pods with self-match-bootstrap required affinity serialize to one
+    admission per round — the alternation needs far more than B=32 total
+    rounds.  Under the old shared budget the loop stopped at B rounds
+    with feasible pods unassigned (then failed with
+    preemption_may_help=True); with admissions tracked separately every
+    feasible pod must place."""
+    from kubetpu.harness import hollow
+
+    nodes = [mknode(name=f"n{i}", labels={api.LABEL_ZONE: "z0"})
+             for i in range(4)]
+    pending = []
+    for i in range(24):                      # infeasible head
+        pending.append(mkpod(name=f"big{i:02d}", cpu="900"))
+    for i in range(8):                       # serially-admitted tail
+        p = mkpod(name=f"boot{i}", labels={"app": f"g{i}"})
+        hollow.with_affinity(p, api.LABEL_ZONE)   # matches own labels ->
+        pending.append(p)                         # self-match bootstrap
+    cluster, batch, cfg, _ = build(
+        nodes, {}, pending,
+        filters=FIT_FILTERS + ("InterPodAffinity",))
+    g = gang.schedule_gang(cluster, batch, cfg, jax.random.PRNGKey(7),
+                           residual_window=4)
+    chosen = np.asarray(g.chosen)[:32]
+    assert (chosen[:24] == -1).all()
+    assert (chosen[24:] >= 0).all(), (
+        f"feasible bootstrap pods starved: {chosen[24:]}")
+    assert_no_capacity_violation(cluster, batch, np.asarray(g.chosen))
+    # the scenario genuinely exceeds the old shared budget of B rounds —
+    # otherwise this test would pass on the buggy code too
+    assert int(g.rounds) > 32

@@ -1,9 +1,17 @@
 #!/bin/sh
 # CI lint gate: kubelint in JSON mode, nonzero exit on any unsuppressed
 # finding.  Covers all five rule families — host-sync, recompile, numeric,
-# purity, and concurrency (lock discipline for the threaded host path).
-# Builders run this by default via `make lint`; the same check gates
-# tier-1 through tests/test_kubelint.py::test_kubetpu_tree_is_clean.
+# purity, and concurrency (lock discipline for the threaded host path,
+# including the flight-recorder classes: utils/trace.py FlightRecorder /
+# CycleRecord and utils/decisions.py DecisionLog are guarded-by annotated
+# and must stay tree-clean).  Builders run this by default via
+# `make lint`; the same check gates tier-1 through
+# tests/test_kubelint.py::test_kubetpu_tree_is_clean.
 set -e
 cd "$(dirname "$0")/.."
 python -m tools.kubelint kubetpu/ --json
+# explicit concurrency-family pass over the observability layer: the new
+# lock-guarded recorder/audit classes must be clean on their own, so a
+# future refactor can't hide a violation behind an unrelated suppression
+python -m tools.kubelint kubetpu/utils/trace.py kubetpu/utils/decisions.py \
+	--rules concurrency --json

@@ -1,10 +1,22 @@
-"""Record the pipelined drain's stage timeline (VERDICT r4 #2 evidence:
-the overlap must be visible in a committed trace).
+"""Record the pipelined drain's stage timeline from the FLIGHT RECORDER
+(VERDICT r4 #2 evidence: the overlap must be visible in a committed
+trace).
 
-Wraps the scheduler's prepare/readback/dispatch/commit stages with
-wall-clock spans and writes PIPELINE_TRACE.json: for each serving call,
-the spans show cycle k's PREPARE and DISPATCH starting before cycle
-k-1's COMMIT has run, and the packed readback as the only device sync.
+The recorder (kubetpu/utils/trace.py) captures every cycle's span tree —
+prepare/tensorize steps, dispatch, packed-readback (with device-wait
+attribution), commit, preemption wave, binds — so this tool no longer
+monkeypatches the scheduler: it arms the recorder, drives the pipelined
+drain, and exports the ring as
+
+  * PIPELINE_TRACE.json          flat stage/cycle span list + span_total
+  * PIPELINE_TRACE.perfetto.json Chrome traceEvents (load in
+                                 ui.perfetto.dev; ph:"X" count ==
+                                 span_total)
+
+The overlap shows as cycle k's "dispatch" span starting before cycle
+k-1's "commit" span has run, with "packed-readback" as the only device
+sync.  `python tools/traceview.py PIPELINE_TRACE.json` prints the text
+flame summary.
 
 Usage: python tools/trace_pipeline.py
 """
@@ -13,7 +25,6 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -21,43 +32,16 @@ import bench  # noqa: E402
 from kubetpu.apis.config import (KubeSchedulerConfiguration,  # noqa: E402
                                  KubeSchedulerProfile)
 from kubetpu.scheduler import Scheduler  # noqa: E402
-
-SPANS = []
-T0 = [0.0]
-
-
-def wrap(cls, name, label, cycle_of):
-    orig = getattr(cls, name)
-
-    def wrapped(self, *a, **kw):
-        t = time.time() - T0[0]
-        out = orig(self, *a, **kw)
-        SPANS.append({"stage": label, "cycle": cycle_of(a),
-                      "start_s": round(t, 4),
-                      "end_s": round(time.time() - T0[0], 4)})
-        return out
-    setattr(cls, name, wrapped)
+from kubetpu.utils import trace as utrace  # noqa: E402
 
 
 def main():
-    counter = {"prep": 0, "dispatch": 0, "finish": 0}
-
-    def count(key):
-        def f(_a):
-            counter[key] += 1
-            return counter[key]
-        return f
-
-    wrap(Scheduler, "_prepare_group", "prepare+tensorize", count("prep"))
-    wrap(Scheduler, "_dispatch_group", "dispatch(auction+materialize)",
-         count("dispatch"))
-    wrap(Scheduler, "_readback_group", "packed-readback(sync)",
-         lambda a: counter["finish"] + 1)
-    wrap(Scheduler, "_commit_group", "commit(Reserve/assume/bind)",
-         count("finish"))
-
+    flight = utrace.arm_flight_recorder()
+    sched = None
     for warm in (False, True):
-        SPANS.clear()
+        if sched is not None:
+            sched.close()
+        flight.clear()
         store, pending = bench.build_world(1000, 4096, 2)
         sched = Scheduler(store, config=KubeSchedulerConfiguration(
             profiles=[KubeSchedulerProfile()], batch_size=1024,
@@ -65,29 +49,25 @@ def main():
             prewarm=False), async_binding=False)
         for p in pending:
             store.add(p)
-        for k in counter:
-            counter[k] = 0
-        T0[0] = time.time()
         sched.device_wait_s = 0.0
         while True:
             if not sched.schedule_pending(timeout=0.0):
                 break
-        total = time.time() - T0[0]
-        sched.close()
-    doc = {
-        "workload": "4096 pods x 1000 nodes, 1024-pod pipelined cycles",
-        "total_s": round(total, 3),
-        "device_wait_s": round(sched.device_wait_s, 3),
-        "note": "cycle k's prepare/dispatch precede cycle k-1's commit: "
-                "the device executes cycle k while the host commits k-1 "
-                "(the packed readback is the only sync point)",
-        "spans": SPANS,
-    }
-    with open("PIPELINE_TRACE.json", "w") as f:
-        json.dump(doc, f, indent=1)
-    print(json.dumps({"total_s": doc["total_s"],
+    doc = flight.to_pipeline_doc(
+        workload="4096 pods x 1000 nodes, 1024-pod pipelined cycles "
+                 "(warm pass)")
+    doc["note"] = ("cycle k's dispatch precedes cycle k-1's commit: the "
+                   "device executes cycle k while the host commits k-1 "
+                   "(the packed readback is the only sync point)")
+    doc["scheduler_device_wait_s"] = round(sched.device_wait_s, 3)
+    sched.close()
+    bench.atomic_write_json("PIPELINE_TRACE.json", doc)
+    bench.atomic_write_json("PIPELINE_TRACE.perfetto.json",
+                            flight.to_chrome_trace())
+    print(json.dumps({"total_s": doc.get("total_s"),
                       "device_wait_s": doc["device_wait_s"],
-                      "spans": len(SPANS)}))
+                      "cycles": doc["cycles"],
+                      "spans": doc["span_total"]}))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,165 @@
+"""Text flame summary for flight-recorder traces (`make trace`).
+
+Reads either export of kubetpu's flight recorder:
+
+  * the flat span-list document (PIPELINE_TRACE.json — bench.py /
+    tools/trace_pipeline.py / /debug/flightz?format=json cycles), or
+  * Chrome traceEvents JSON (PIPELINE_TRACE.perfetto.json /
+    /debug/flightz?format=chrome)
+
+and prints (1) a per-stage aggregate table — count, total/mean wall
+time, share of the trace window, attributed device wait — and (2) the
+span tree of the slowest cycles, indented by parent linkage with per-span
+durations and thread tags.
+
+Usage:
+  python tools/traceview.py [TRACE.json] [--cycles N] [--threshold-ms M]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+
+def _load_spans(doc) -> List[dict]:
+    """Normalize either export to span dicts: stage/cycle/thread/
+    span_id/parent_id/start_s/end_s/args."""
+    if "spans" in doc:        # pipeline doc (tolerates the pre-recorder
+        out = []              # ad-hoc span list: ids/threads optional)
+        for i, s in enumerate(doc["spans"]):
+            out.append({"stage": s.get("stage", s.get("name", "?")),
+                        "cycle": s.get("cycle", 0),
+                        "thread": s.get("thread", ""),
+                        "span_id": s.get("span_id", i + 1),
+                        "parent_id": s.get("parent_id", 0),
+                        "start_s": s.get("start_s", 0.0),
+                        "end_s": s.get("end_s", s.get("start_s", 0.0)),
+                        "args": s.get("args", {})})
+        return out
+    if "cycles" in doc and isinstance(doc.get("cycles"), list):
+        # /debug/flightz dump: nested per-cycle span trees
+        out = []
+        t_base = min((c["t0"] for c in doc["cycles"]), default=0.0)
+        for c in doc["cycles"]:
+            for s in c.get("spans", []):
+                out.append({"stage": s["name"], "cycle": c["seq"],
+                            "thread": s.get("thread", ""),
+                            "span_id": s["id"], "parent_id": s["parent"],
+                            "start_s": s["t0"] - t_base,
+                            "end_s": s["t1"] - t_base,
+                            "args": s.get("args", {})})
+        return out
+    if "traceEvents" in doc:  # Chrome export
+        xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        t_base = min((e["ts"] for e in xs), default=0)
+        return [{"stage": e["name"],
+                 "cycle": e.get("args", {}).get("cycle", 0),
+                 "thread": str(e.get("tid", "")),
+                 "span_id": e.get("args", {}).get("span_id", 0),
+                 "parent_id": e.get("args", {}).get("parent_id", 0),
+                 "start_s": (e["ts"] - t_base) / 1e6,
+                 "end_s": (e["ts"] - t_base + e.get("dur", 0)) / 1e6,
+                 "args": e.get("args", {})} for e in xs]
+    raise SystemExit("unrecognized trace document (expected a flight-"
+                     "recorder pipeline doc, flightz dump, or Chrome "
+                     "traceEvents JSON)")
+
+
+def _bar(frac: float, width: int = 24) -> str:
+    n = max(0, min(width, int(round(frac * width))))
+    return "#" * n + "." * (width - n)
+
+
+def flame_summary(spans: List[dict]) -> str:
+    if not spans:
+        return "no spans recorded"
+    window = (max(s["end_s"] for s in spans)
+              - min(s["start_s"] for s in spans)) or 1e-9
+    by_stage: Dict[str, List[dict]] = {}
+    for s in spans:
+        by_stage.setdefault(s["stage"], []).append(s)
+    lines = [f"{len(spans)} spans over {window:.3f}s "
+             f"({len(set(s['cycle'] for s in spans))} cycles)", "",
+             f"{'stage':<44} {'n':>5} {'total_s':>8} {'mean_ms':>8} "
+             f"{'dev_wait_s':>10}  share"]
+    rows = []
+    for stage, ss in by_stage.items():
+        total = sum(s["end_s"] - s["start_s"] for s in ss)
+        dev = sum(s.get("args", {}).get("device_wait_s", 0.0) for s in ss)
+        rows.append((total, stage, ss, dev))
+    for total, stage, ss, dev in sorted(rows, reverse=True):
+        lines.append(
+            f"{stage[:44]:<44} {len(ss):>5} {total:>8.3f} "
+            f"{1000 * total / len(ss):>8.1f} {dev:>10.3f}  "
+            f"{_bar(total / window)} {100 * total / window:5.1f}%")
+    return "\n".join(lines)
+
+
+def cycle_tree(spans: List[dict], cycle: int,
+               threshold_ms: float = 0.0) -> str:
+    cs = [s for s in spans if s["cycle"] == cycle]
+    by_parent: Dict[int, List[dict]] = {}
+    for s in cs:
+        by_parent.setdefault(s["parent_id"], []).append(s)
+    for kids in by_parent.values():
+        kids.sort(key=lambda s: s["start_s"])
+    known = {s["span_id"] for s in cs}
+    lines = [f"cycle {cycle}:"]
+
+    def walk(parent: int, depth: int) -> None:
+        for s in by_parent.get(parent, []):
+            dur_ms = 1000 * (s["end_s"] - s["start_s"])
+            if dur_ms < threshold_ms and depth > 1:
+                continue
+            extra = ""
+            dev = s.get("args", {}).get("device_wait_s")
+            if dev:
+                extra = f"  [device_wait {1000 * dev:.1f}ms]"
+            thread = s.get("thread", "")
+            lines.append(f"  {'  ' * depth}{s['stage']:<40} "
+                         f"{dur_ms:>9.1f}ms  ({thread}){extra}")
+            walk(s["span_id"], depth + 1)
+
+    # roots: parent 0 or parent outside this cycle's recorded set
+    roots = sorted({s["parent_id"] for s in cs
+                    if s["parent_id"] == 0 or s["parent_id"] not in known})
+    for r in roots:
+        walk(r, 0)
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="traceview",
+        description="text flame summary for kubetpu flight-recorder "
+                    "traces")
+    ap.add_argument("trace", nargs="?", default="PIPELINE_TRACE.json")
+    ap.add_argument("--cycles", type=int, default=2,
+                    help="show the span tree of the N slowest cycles")
+    ap.add_argument("--threshold-ms", type=float, default=0.5,
+                    help="hide sub-spans shorter than this in the trees")
+    args = ap.parse_args(argv)
+    with open(args.trace) as f:
+        doc = json.load(f)
+    spans = _load_spans(doc)
+    print(flame_summary(spans))
+    if not spans:
+        return 0
+    wall: Dict[int, float] = {}
+    for s in spans:
+        wall[s["cycle"]] = max(wall.get(s["cycle"], 0.0),
+                               s["end_s"]) - 0.0
+    span_of = {c: min(s["start_s"] for s in spans if s["cycle"] == c)
+               for c in wall}
+    slowest = sorted(wall, key=lambda c: wall[c] - span_of[c],
+                     reverse=True)[:max(args.cycles, 0)]
+    for c in slowest:
+        print()
+        print(cycle_tree(spans, c, threshold_ms=args.threshold_ms))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
