@@ -3,7 +3,7 @@
 # lint gate via tests/test_kubelint.py).  `make help` lists everything.
 
 .PHONY: help lint lock-graph test sanitize-test race-test flight-test \
-	trace bench
+	delta-test trace bench
 
 help:
 	@echo "kubetpu targets:"
@@ -21,6 +21,9 @@ help:
 	@echo "  make flight-test    flight recorder + decision audit suite (ring"
 	@echo "                      wrap/drops, Chrome-trace schema, /debug"
 	@echo "                      endpoints, disarmed no-op)"
+	@echo "  make delta-test     incremental tensorization suite (delta-vs-"
+	@echo "                      rebuild golden equivalence, resync fallbacks,"
+	@echo "                      scatter compile-once watchdog, bench gate)"
 	@echo "  make trace          run the pipelined drain with the flight"
 	@echo "                      recorder armed, write PIPELINE_TRACE.json +"
 	@echo "                      .perfetto.json, print the text flame summary"
@@ -54,6 +57,12 @@ race-test:
 flight-test:
 	JAX_PLATFORMS=cpu python -m pytest \
 		tests/test_flightrecorder.py -q -p no:cacheprovider
+
+# incremental tensorization (state/delta.py): golden equivalence vs full
+# rebuild, fallback triggers, scatter-program compile-once contract
+delta-test:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_delta.py -q -p no:cacheprovider
 
 # pipelined-drain trace via the flight recorder + text flame summary
 # (PIPELINE_TRACE.json + PIPELINE_TRACE.perfetto.json for ui.perfetto.dev)

@@ -197,6 +197,11 @@ def run_mode(mode, n_nodes, n_pods, existing_per_node, repeats,
             "cycle_p99_s": round(_percentile(cycle_times, 0.99), 3),
             "device_wait_s": round(sched.device_wait_s, 3),
             "host_share": round(1.0 - sched.device_wait_s / max(dt, 1e-9), 3),
+            # incremental tensorization (state/delta.py): rows the scatter
+            # path updated per delta cycle + how often the blessed full
+            # rebuild ran (last attempt's drain)
+            "delta_rows_p50": _median(list(sched.delta_rows)),
+            "resync_count": sched.resync_count,
         }
         if mode == "gang":
             stats["auction_rounds_max"] = max(cycle_rounds, default=0)
@@ -252,20 +257,92 @@ def explain(sched, outcomes):
     return counts
 
 
+def compile_estimate(first, best):
+    """First-run-minus-best is only a compile ESTIMATE; with the
+    persistent XLA cache the first run can be the fastest (every compile
+    is a cache load) and the raw subtraction went negative (BENCH_r05
+    chain_on: -0.3).  This is the SINGLE point where compile_s is
+    computed — every reporting path (headline modes, chain_drain's
+    chain_on/chain_off/pipelined cases, northstar) flows through
+    mode_summary and so through this clamp."""
+    return round(max(first - best, 0.0), 1)
+
+
 def mode_summary(mode, best, first, outcomes, sched, stats):
     scheduled = sum(1 for o in outcomes if o.node)
     d = {"e2e_best_s": round(best, 3),
          "first_run_s": round(first, 3),
-         # first-run-minus-best is only a compile ESTIMATE; with the
-         # persistent XLA cache the first run can be the fastest (every
-         # compile is a cache load) and the raw subtraction went negative
-         # (BENCH_r05 chain_on: -0.3) — clamp at zero
-         "compile_s": round(max(first - best, 0.0), 1),
-         "scheduled": scheduled}
+         "compile_s": compile_estimate(first, best),
+         "scheduled": scheduled,
+         "pods_per_sec": round(len(outcomes) / best, 1)}
     d.update(stats or {})
     if scheduled < len(outcomes):
         d["unscheduled_by_filter"] = explain(sched, outcomes)
     return d, len(outcomes) / best
+
+
+def _gate_path(detail, dotted):
+    cur = detail
+    for part in dotted.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return cur if isinstance(cur, (int, float)) else None
+
+
+def gate_entries(detail):
+    """Build the NORTHSTAR.json "gate" section from a run's detail doc:
+    dotted-path throughput metrics with a floor fraction derived from the
+    recorded min/median warm spread (a current run below
+    value * min_frac is a regression, not tunnel variance).  Recorded by
+    BENCH_FULL=1 runs; consumed by northstar_gate (BENCH_GATE=1)."""
+    out = {}
+
+    def rel_spread(spread):
+        med, mn = spread.get("median_s"), spread.get("min_s")
+        if not med or mn is None:
+            return 0.15
+        return max(0.05, (med - mn) / med)
+
+    def entry(dotted, case):
+        if case and case.get("pods_per_sec"):
+            out[dotted] = {
+                "pods_per_sec": case["pods_per_sec"],
+                "min_frac": round(max(0.7, 1.0 - 2 * rel_spread(
+                    case.get("spread", {}))), 3)}
+
+    entry("gang.pods_per_sec", detail.get("gang"))
+    cd = detail.get("chain_drain", {})
+    for name in ("pipelined", "chain_on", "chain_off", "delta_sparse"):
+        entry(f"chain_drain.{name}.pods_per_sec", cd.get(name))
+    return out
+
+
+def northstar_gate(detail, path="NORTHSTAR.json"):
+    """BENCH_GATE=1 drift gate: compare this run's gang / chain_drain
+    throughput against the floors recorded in NORTHSTAR.json's "gate"
+    section and return the list of regressions (empty = pass).  Metrics
+    missing on either side are skipped — a gate-less NORTHSTAR.json (or a
+    run without the chain_drain case) passes vacuously, so the gate can
+    ride every CI run and only bite after a BENCH_FULL re-anchor records
+    floors for this backend."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return []
+    failures = []
+    for dotted, ref in sorted((doc.get("gate") or {}).items()):
+        cur = _gate_path(detail, dotted)
+        value = ref.get("pods_per_sec")
+        if cur is None or not value:
+            continue
+        floor = value * ref.get("min_frac", 0.85)
+        if cur < floor:
+            failures.append(
+                f"{dotted}: {cur} pods/s < floor {round(floor, 1)} "
+                f"(recorded {value}, min_frac {ref.get('min_frac', 0.85)})")
+    return failures
 
 
 def chain_drain_case(n_nodes, n_pods, existing_per_node):
@@ -291,6 +368,22 @@ def chain_drain_case(n_nodes, n_pods, existing_per_node):
     out["pipeline_speedup"] = round(
         on["e2e_best_s"] / max(out["pipelined"]["e2e_best_s"], 1e-9), 3)
     out["batch_cap"] = cap
+    # the delta-tensorization target shape: SMALL waves against the full
+    # cluster (chain OFF so every cycle exercises the scatter path) —
+    # per-cycle churn is a handful of rows, exactly the case the
+    # device-resident delta pipeline replaces the full rebuild for;
+    # delta_rows_p50 / resync_count in the stats attribute the win
+    try:
+        best, first, outcomes, sched, stats = run_mode(
+            "gang", n_nodes, max(128, n_pods // 8), existing_per_node,
+            repeats=1, batch_cap=max(64, n_pods // 64), chain=False)
+        d, pods_per_sec = mode_summary("gang", best, first, outcomes,
+                                       sched, stats)
+        sched.close()
+        out["delta_sparse"] = d
+    except Exception as e:  # pragma: no cover - depends on device state
+        # never let the extra shape discard the three finished cases
+        out["delta_sparse"] = {"error": repr(e)}
     return out
 
 
@@ -672,6 +765,9 @@ def main() -> None:
                 n_nodes=5120, existing_per_node=1)
         except Exception as e:  # pragma: no cover
             northstar["warm_restart_5120n"] = {"error": repr(e)}
+        # record drift-gate floors for this backend next to the northstar
+        # shapes, so BENCH_GATE=1 runs can detect regressions
+        northstar["gate"] = gate_entries(detail)
         detail["northstar"] = northstar
         atomic_write_json("NORTHSTAR.json", northstar)
 
@@ -682,6 +778,18 @@ def main() -> None:
     if out_path:
         atomic_write_json(out_path,
                           {"headline": headline_doc, "detail": detail})
+
+    # BENCH_GATE=1: fail the run (exit 3) when gang/chain_drain throughput
+    # regresses beyond the floors recorded in NORTHSTAR.json — perf
+    # regressions surface in CI instead of at the next re-anchor.  Runs
+    # AFTER the artifacts are written so a failing run is still inspectable.
+    if os.environ.get("BENCH_GATE", "0") == "1":
+        failures = northstar_gate(detail)
+        if failures:
+            print(json.dumps({"bench_gate": "FAIL",
+                              "regressions": failures}), file=sys.stderr)
+            sys.exit(3)
+        print(json.dumps({"bench_gate": "PASS"}), file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -233,6 +233,10 @@ class ThroughputCollector:
         return False
 
 
+def _p50(xs: List[int]) -> int:
+    return sorted(xs)[len(xs) // 2] if xs else 0
+
+
 def _stats(samples: List[float]) -> Dict[str, float]:
     if not samples:
         return {"Average": 0.0, "Perc50": 0.0, "Perc90": 0.0, "Perc99": 0.0}
@@ -286,6 +290,8 @@ def run_workload(w: Workload, verbose: bool = False) -> List[DataItem]:
         # phase 2: measured pods
         device_wait0 = sched.device_wait_s
         cycles0 = sched.cycle_count
+        resyncs0 = sched.resync_count
+        delta0 = sched.delta_cycle_count
         t_measured = time.time()
         for i in range(w.num_pods_to_schedule):
             store.add(_make_pod(w, i, "measured", store))
@@ -309,7 +315,20 @@ def run_workload(w: Workload, verbose: bool = False) -> List[DataItem]:
             DataItem(data={"Cycles": float(sched.cycle_count - cycles0),
                            "DeviceWaitS": round(device_wait, 3),
                            "HostShare": round(
-                               1.0 - device_wait / max(elapsed, 1e-9), 3)},
+                               1.0 - device_wait / max(elapsed, 1e-9), 3),
+                           # incremental-tensorization health (state/delta)
+                           # over the MEASURED phase only, like Cycles:
+                           # rows the scatter path updated per delta cycle
+                           # + how often the blessed full rebuild ran
+                           # the measured-phase tail of the bounded ring:
+                           # the monotonic cycle counter stays correct
+                           # even after the deque evicts warm-up entries
+                           "Resyncs": float(sched.resync_count - resyncs0),
+                           "DeltaRowsP50": float(_p50(
+                               list(sched.delta_rows)[
+                                   -(sched.delta_cycle_count - delta0):]
+                               if sched.delta_cycle_count > delta0
+                               else []))},
                      unit="mixed",
                      labels={"Name": w.name, "Metric": "SchedulerStats"}),
         ]

@@ -422,6 +422,71 @@ def whatif_wave(cluster, static_ok, wave_req, cand_rows, cand_valid,
         [fits0[:, :, None], jnp.moveaxis(reprieved, 0, -1)], axis=2)
 
 
+def _apply_cluster_delta(cluster, delta):
+    """Scatter one cycle's ClusterDelta tables (state/tensors.py) into the
+    device-resident ClusterTensors.  Row vectors are padded with
+    one-past-capacity indices, so ``mode="drop"`` discards the pads (a -1
+    pad would WRAP to the last row); duplicate REAL rows never occur (the
+    host dedups dirty rows before gathering).  The compact label-id lists
+    densify on device exactly like HostClusterArrays.to_device, so a
+    delta-applied cluster stays byte-identical to a rebuild."""
+    from ..state.tensors import _densify_ids
+
+    nr, pr = delta.node_rows, delta.pod_rows
+    L = cluster.kv.shape[1]
+
+    def scat(x, rows, vals):
+        return x.at[rows].set(vals, mode="drop")
+
+    return cluster._replace(
+        allocatable=scat(cluster.allocatable, nr, delta.allocatable),
+        requested=scat(cluster.requested, nr, delta.requested),
+        nonzero_requested=scat(cluster.nonzero_requested, nr,
+                               delta.nonzero_requested),
+        node_valid=scat(cluster.node_valid, nr, delta.node_valid),
+        unschedulable=scat(cluster.unschedulable, nr, delta.unschedulable),
+        kv=scat(cluster.kv, nr, _densify_ids(delta.kv_ids, L=L)),
+        keymask=scat(cluster.keymask, nr, delta.keymask),
+        num=scat(cluster.num, nr, delta.num),
+        topo_pair=scat(cluster.topo_pair, nr, delta.topo_pair),
+        taints=scat(cluster.taints, nr, delta.taints),
+        ports=scat(cluster.ports, nr, delta.ports),
+        images=scat(cluster.images, nr, delta.images),
+        avoid_hot=scat(cluster.avoid_hot, nr, delta.avoid_hot),
+        zone_hot=scat(cluster.zone_hot, nr, delta.zone_hot),
+        image_size=jnp.asarray(delta.image_size),
+        image_spread=jnp.asarray(delta.image_spread),
+        taint_is_hard=jnp.asarray(delta.taint_is_hard),
+        taint_is_prefer=jnp.asarray(delta.taint_is_prefer),
+        pod_kv=scat(cluster.pod_kv, pr, _densify_ids(delta.pod_kv_ids, L=L)),
+        pod_key=scat(cluster.pod_key, pr, delta.pod_key),
+        pod_ns_hot=scat(cluster.pod_ns_hot, pr, delta.pod_ns_hot),
+        pod_node=scat(cluster.pod_node, pr, delta.pod_node),
+        pod_valid=scat(cluster.pod_valid, pr, delta.pod_valid),
+        pod_terminating=scat(cluster.pod_terminating, pr,
+                             delta.pod_terminating))
+
+
+# the donated variant updates the resident buffers in place (the cluster
+# lives on device across cycles and nobody else may hold it); the
+# no-donate twin serves the pipelined drain's rare case where a
+# dispatched-but-uncommitted cycle still reads the same buffers
+_apply_cluster_delta_donated = jax.jit(_apply_cluster_delta,
+                                       donate_argnums=(0,))
+_apply_cluster_delta_shared = jax.jit(_apply_cluster_delta)
+
+
+def apply_cluster_delta(cluster, delta, donate: bool = True):
+    """Apply a ClusterDelta on device.  delta leaves must already be
+    pow2-bucketed (state/tensors.gather_delta) so repeated same-bucket
+    deltas hit one compiled program.  donate=False keeps the input
+    buffers alive (in-flight pipelined reader)."""
+    delta = jax.tree.map(jnp.asarray, delta)
+    fn = (_apply_cluster_delta_donated if donate
+          else _apply_cluster_delta_shared)
+    return fn(cluster, delta)
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def filter_and_score(cluster, batch, cfg: ProgramConfig,
                      host_ok=None) -> FilterScoreResult:

@@ -83,7 +83,16 @@ def _put(x, sharding: NamedSharding):
     """device_put that also works on MULTI-PROCESS meshes: for
     non-fully-addressable shardings, build the global array from each
     process's addressable shards (device_put would run a cross-process
-    same-value assert that trips on NaN padding — NaN != NaN)."""
+    same-value assert that trips on NaN padding — NaN != NaN).
+
+    Arrays already committed to the requested sharding pass through
+    untouched — the delta-maintained resident cluster
+    (state/delta.py DeltaTensorizer with a mesh) re-enters
+    shard_cluster every dispatch, and re-``device_put``-ing the whole
+    [N, R] tensors each cycle was exactly the host cost the delta
+    pipeline removes."""
+    if isinstance(x, jax.Array) and x.sharding == sharding:
+        return x
     if getattr(sharding, "is_fully_addressable", True):
         return jax.device_put(x, sharding)
     arr = np.asarray(x)
@@ -124,6 +133,20 @@ def shard_batch(batch, mesh: Mesh):
 def replicate(tree, mesh: Mesh):
     return jax.tree.map(
         lambda x: _put(x, NamedSharding(mesh, P())), tree)
+
+
+def sharded_apply_cluster_delta(cluster, delta, mesh: Mesh,
+                                donate: bool = True):
+    """Apply a ClusterDelta to the SHARDED resident cluster, shard-locally:
+    the [D]-indexed update tables are tiny and ride replicated, and the
+    SPMD partitioner lowers each ``x.at[rows].set`` into per-shard
+    scatters — no shard ever re-materializes (or re-uploads) the full
+    [N, R] / [P, L] tensors.  The cluster keeps its committed shardings,
+    so the next dispatch's shard_cluster is a pass-through."""
+    from ..models import programs
+    delta = replicate(jax.tree.map(np.asarray, delta), mesh)
+    with jax.set_mesh(mesh):
+        return programs.apply_cluster_delta(cluster, delta, donate=donate)
 
 
 def sharded_schedule_batch(cluster, batch, cfg: programs.ProgramConfig, rng,

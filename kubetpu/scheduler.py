@@ -43,6 +43,7 @@ from .models.sequential import schedule_sequential
 from .plugins.intree import new_in_tree_registry
 from .schedqueue.queue import SchedulingQueue
 from .state.cache import SchedulerCache, Snapshot
+from .state.delta import DeltaTensorizer
 from .state.tensors import SnapshotBuilder
 from .utils import trace as utrace
 from .utils.decisions import DecisionLog, PodDecision
@@ -50,11 +51,11 @@ from .utils.trace import Trace
 
 
 def _vocab_caps(table):
-    """Snapshot of every vocab's pow2 capacity — chained cycles compare
-    this to detect bucket overflow (tensor shapes would change)."""
-    return tuple((n, getattr(table, n).cap) for n in
-                 ("kv", "key", "ns", "topokey", "rname", "port", "taint",
-                  "image", "avoid"))
+    """Tensor-width signature chained cycles compare to detect overflow
+    (tensor shapes would change) — ONE definition shared with the
+    DeltaTensorizer's resync guard, see state/tensors.vocab_signature."""
+    from .state.tensors import vocab_signature
+    return vocab_signature(table)
 
 
 @dataclass
@@ -195,6 +196,18 @@ class Scheduler:
         # (failed-uid set, audit rows) of the last decision audit — the
         # retry-churn dedup in _commit_group (serving thread only)
         self._audit_cache = None
+        # incremental tensorization (state/delta.py): one device-resident
+        # cluster per profile, updated by bounded scatters; the full
+        # rebuild is demoted to its anti-entropy resync (serving thread
+        # only, like _audit_cache)
+        self._delta: Dict[str, DeltaTensorizer] = {}
+        # delta telemetry for bench/perf: updated-row counts of recent
+        # delta cycles (bounded ring) + monotonic tallies so windowed
+        # readers survive ring eviction (serving thread only)
+        from collections import deque
+        self.delta_rows = deque(maxlen=4096)
+        self.delta_cycle_count = 0
+        self.resync_count = 0
         # pipelined drain: the dispatched-but-uncommitted cycle (prep, res)
         self._inflight_cycle = None
         # (pod-axis bucket, compile-or-load seconds) per prewarmed program
@@ -420,8 +433,11 @@ class Scheduler:
             # prepare k: host tensorize work that overlaps cycle k-1's
             # device execution (the real overlap — the tunnel serves
             # transfers FIFO behind queued programs, so everything after
-            # the readback below is serialized with the device)
-            prep, early = self._prepare_group(fwk, group)
+            # the readback below is serialized with the device).
+            # uncommitted=prev: k-1's buffers must not be donated away
+            # before its commit-side device work runs
+            prep, early = self._prepare_group(
+                fwk, group, uncommitted=prev[0] if prev else None)
             if prep is None:
                 return (returned + early
                         + (self._finish_group(*prev) if prev else []))
@@ -532,10 +548,13 @@ class Scheduler:
             res = self._dispatch_group(prep)
         return outcomes + self._finish_group(prep, res)
 
-    def _prepare_group(self, fwk: Framework, qpods: List[QueuedPodInfo]):
+    def _prepare_group(self, fwk: Framework, qpods: List[QueuedPodInfo],
+                       uncommitted: Optional[PreparedCycle] = None):
         """Host half of a cycle, up to (but excluding) the device dispatch:
         snapshot, PreFilter, tensorize-or-chain, host filter masks,
-        nominated overlay.  Returns (PreparedCycle | None, early outcomes)."""
+        nominated overlay.  Returns (PreparedCycle | None, early outcomes).
+        uncommitted: a dispatched-but-uncommitted pipelined cycle whose
+        device buffers must survive this prepare (gates delta donation)."""
         # queue depths ride the cycle record; the read takes the queue's
         # condition lock, so it is GATED on the recorder being armed (the
         # disarmed hot path must take no new locks)
@@ -613,15 +632,54 @@ class Scheduler:
             cluster = chain["cluster"]
             chain_pod_uids = chain["pod_uids"]
         else:
-            builder = SnapshotBuilder(
-                hard_pod_affinity_weight=fwk.hard_pod_affinity_weight)
-            builder.intern_pending(pinfos + nom_pinfos)
-            host_arrays = builder.build(node_infos)
-            cluster = host_arrays.to_device()
-            chain_pod_uids = [pi.pod.uid for ni in node_infos
-                              for pi in ni.pods]
-            chain_pod_uids += [None] * (int(cluster.pod_valid.shape[0])
-                                        - len(chain_pod_uids))
+            # incremental tensorization (state/delta.py): the resident
+            # device cluster is brought up to date by a bounded scatter
+            # over the cycle's dirty rows; a full build() runs only on the
+            # DeltaTensorizer's blessed resync path.  The chain branch
+            # above is the zero-delta special case of the same pipeline.
+            delta = self._delta.get(fwk.profile_name)
+            if delta is None:
+                delta = DeltaTensorizer(
+                    hard_pod_affinity_weight=fwk.hard_pod_affinity_weight,
+                    mesh=self._mesh, profile=fwk.profile_name)
+                self._delta[fwk.profile_name] = delta
+            # in-place buffer donation is only safe when no
+            # dispatched-but-uncommitted pipelined cycle still reads the
+            # resident buffers (its commit-side preemption wave and
+            # decision audit dispatch against prep.cluster).  The
+            # pipelined drain passes its in-flight cycle explicitly (it
+            # detaches self._inflight_cycle before preparing).
+            inflight = [uncommitted]
+            if self._inflight_cycle is not None:
+                inflight.append(self._inflight_cycle[0])
+            donate = not any(p is not None and p.cluster is delta.cluster
+                             for p in inflight)
+            # pending/nominated pods intern inside refresh (a compacting
+            # resync re-interns them into its fresh table)
+            cluster, dstats = delta.refresh(
+                node_infos, pending=pinfos + nom_pinfos, donate=donate)
+            # AFTER refresh: a compacting resync swaps the builder
+            builder = delta.builder
+            rec = trace.rec
+            if rec is not None:
+                for name, st0, st1 in dstats.spans:
+                    rec.record_span(name, st0, st1,
+                                    parent_id=trace.span_id,
+                                    delta_rows=dstats.delta_rows)
+                rec.meta["delta_rows"] = dstats.delta_rows
+                rec.meta["resync"] = dstats.resync
+                if dstats.resync:
+                    rec.event("resync", parent_id=trace.span_id,
+                              reason=dstats.reason)
+            if dstats.resync:
+                self.resync_count += 1
+            elif dstats.delta_rows > 0:
+                # zero-dirty cycles (retry churn with no cache events) ran
+                # no scatter — counting them would drag the row p50 to 0
+                # and diverge from the span-based traceview digest
+                self.delta_rows.append(dstats.delta_rows)
+                self.delta_cycle_count += 1
+            chain_pod_uids = delta.pod_uid_list()
             with self._chain_lock:
                 self._chain = None
         spread_sels = [self.store.default_spread_selector(pi.pod)

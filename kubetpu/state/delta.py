@@ -1,0 +1,437 @@
+"""Incremental delta-tensorization: device-resident cluster state updated
+by scatter, not rebuilt.
+
+The flight recorder (PR 4) showed the serving host — not the device — is
+the drain bottleneck: every non-chained cycle paid a full
+``HostClusterArrays.build()`` walk over ALL nodes plus a fresh host→device
+transfer, even when the cycle changed a handful of rows.  The
+``DeltaTensorizer`` keeps ONE ``ClusterTensors`` alive on device across
+cycles and, from the cache's commit/bind/evict/watch churn (per-node
+``NodeInfo.generation`` bumps), emits compact ``[D]``-indexed update
+tables (``state/tensors.py ClusterDelta``) applied by a donated, jit'd
+scatter program (``models/programs.py apply_cluster_delta``,
+``x.at[rows].set(..., mode="drop")`` so buffers update in place), bucketed
+by ``pow2_bucket(D)`` to avoid recompiles.
+
+The scheduler's gang-mode cycle CHAIN is the zero-delta special case of
+this pipeline: the chain covers self-inflicted churn (the auction's own
+placements, already materialized on device by ``materialize_assigned``),
+while the DeltaTensorizer covers everything else — external binds, node
+updates, evictions (including the preemption wave's victim deletions,
+which reach it as ordinary cache churn and ride the same delta tables) —
+and replaces the full rebuild as the chain-break recovery path.
+
+Full rebuild remains the FALLBACK, demoted to an anti-entropy resync.
+Triggers (each counted and reported through ``DeltaStats.reason``):
+
+  * ``initial``             — no resident cluster yet
+  * ``node-set``            — nodes added/removed/reordered (row ids move)
+  * ``vocab-growth``        — an intern-table pow2 cap crossed (tensor
+                              widths change), or the topokey vocab grew at
+                              all (``topo_pair`` columns are filled at
+                              build time from the key LIST, not the cap)
+  * ``label-capacity``      — a node/pod outgrew the compact [., ML] id
+                              lists
+  * ``delta-too-large``     — dirty fraction above KUBETPU_DELTA_MAX_FRAC
+                              (off by default)
+  * ``anti-entropy``        — KUBETPU_RESYNC_INTERVAL delta cycles elapsed
+  * ``pod-axis-growth``     — pod rows exhausted; the mirror pads to the
+                              next pow2 bucket and re-uploads WITHOUT the
+                              build() walk (the host-walk cost is the
+                              bottleneck, not the transfer)
+
+Term-carrying pod churn is NOT a resync trigger: the flattened
+``ExistingTerms`` rebuild from the term OWNERS alone (``_refresh_terms``,
+the ``delta-terms`` span) and replace wholesale — they are small, and a
+1-in-5-pods-with-anti-affinity drain would otherwise resync every cycle.
+
+Bit-exactness contract (tested by tests/test_delta.py): after any
+sequence of deltas, the resident tensors match a from-scratch ``build()``
+of the same NodeInfos against the same InternTable byte-for-byte, up to
+the documented stable-row permutation of the existing-pod axis (a fresh
+build packs pods in node-walk order; the delta path keeps rows stable and
+reuses freed rows lowest-first).  Known deviation: when several nodes
+report the SAME image with DIFFERENT sizes, build() keeps the last walked
+node's size while the delta path keeps the last updated node's.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from ..utils.intern import pow2_bucket
+from .tensors import (ClusterDelta, HostClusterArrays, SnapshotBuilder,
+                      clear_pod_row, fill_node_row, fill_pod_row,
+                      gather_delta, pod_has_terms, vocab_signature)
+
+RESYNC_INTERVAL_ENV = "KUBETPU_RESYNC_INTERVAL"
+MAX_FRAC_ENV = "KUBETPU_DELTA_MAX_FRAC"
+DEFAULT_RESYNC_INTERVAL = 512
+# dirty-fraction fallback is OFF by default (1.0 = never): even a
+# fully-dirty delta beats a rebuild — the refill walk is the same
+# per-node work, but it skips the intern pass, the term rebuild, the
+# fresh array allocation and most of the transfer.  Operators can lower
+# it (KUBETPU_DELTA_MAX_FRAC=0.5) if a workload proves otherwise.
+DEFAULT_MAX_FRAC = 1.0
+
+# pod-axis mirror fields padded on growth (pad value per field)
+_POD_FIELDS = (("_pod_kv_ids", -1), ("pod_key", False), ("pod_ns_hot", 0.0),
+               ("pod_node", -1), ("pod_valid", False),
+               ("pod_terminating", False))
+
+
+class DeltaStats(NamedTuple):
+    """One refresh()'s outcome — the flight-recorder/bench feed."""
+    delta_rows: int                 # node rows + pod rows actually updated
+    resync: bool
+    reason: str                     # "" on pure delta cycles
+    spans: Tuple[Tuple[str, float, float], ...]  # (name, t0, t1)
+
+
+class DeltaTensorizer:
+    """Keeps ClusterTensors resident on device and updates them by
+    bounded scatters from the cycle's cache churn.
+
+    Owned by the serving thread (like the scheduler's chain); the host
+    mirror (``HostClusterArrays``) is the source of truth the device
+    tensors always equal, and a resync re-derives everything from the
+    snapshot.  ``mesh`` keeps the resident cluster SHARDED so sharded
+    profiles stop re-``device_put``-ing the whole [N, R] tensors — the
+    replicated delta tables scatter into the local shards
+    (parallel/mesh.py sharded_apply_cluster_delta).
+    """
+
+    def __init__(self, hard_pod_affinity_weight: int = 1, mesh=None,
+                 profile: str = "",
+                 resync_interval: Optional[int] = None,
+                 max_delta_frac: Optional[float] = None):
+        self.builder = SnapshotBuilder(
+            hard_pod_affinity_weight=hard_pod_affinity_weight)
+        self.hard_pod_affinity_weight = hard_pod_affinity_weight
+        self.mesh = mesh
+        self.profile = profile
+        self.resync_interval = (resync_interval if resync_interval is not None
+                                else int(os.environ.get(
+                                    RESYNC_INTERVAL_ENV,
+                                    str(DEFAULT_RESYNC_INTERVAL))))
+        self.max_delta_frac = (max_delta_frac if max_delta_frac is not None
+                               else float(os.environ.get(
+                                   MAX_FRAC_ENV, str(DEFAULT_MAX_FRAC))))
+        self.cluster = None                      # device ClusterTensors
+        self.host: Optional[HostClusterArrays] = None
+        self.node_names: List[str] = []          # row order
+        self.node_gen: Dict[str, int] = {}
+        self.node_pods: Dict[str, List[str]] = {}   # name -> uid list
+        self.node_terms: Dict[str, bool] = {}    # name -> owns term pods
+        self.pod_row: Dict[str, int] = {}        # uid -> row
+        self.free_rows: List[int] = []           # kept sorted, pop lowest
+        self.next_pod_row = 0
+        self.caps = None                         # vocab signature
+        self.cycles_since_resync = 0
+        self.resync_count = 0
+
+    # ------------------------------------------------------------- helpers
+
+    def signature(self) -> tuple:
+        """The tensor-width signature of the current vocab (shared with
+        the scheduler's chain guard — state/tensors.vocab_signature)."""
+        return vocab_signature(self.builder.table)
+
+    def pod_uid_list(self) -> List[Optional[str]]:
+        """Row-ordered uid list sized to the pod-axis capacity (the
+        scheduler's chain_pod_uids / CycleContext.pod_rows feed)."""
+        if self.host is None:
+            return []
+        out: List[Optional[str]] = [None] * self.host.arrays[
+            "pod_node"].shape[0]
+        for uid, r in self.pod_row.items():
+            out[r] = uid
+        return out
+
+    # ------------------------------------------------------------- refresh
+
+    def refresh(self, node_infos, pending=(), donate: bool = True):
+        """Bring the resident cluster up to date with the snapshot's
+        NodeInfos.  Returns (cluster, DeltaStats).  pending: PodInfos of
+        this cycle's pending (and nominated) pods — interned HERE so the
+        vocab-growth check always sees them (and so a compacting resync
+        re-interns them into its fresh table).  donate=False keeps the
+        previous device buffers alive (an in-flight pipelined cycle still
+        reads them)."""
+        t0 = time.time()
+        if pending:
+            self.builder.intern_pending(pending)
+        names = [ni.node_name for ni in node_infos]
+        if self.cluster is None:
+            return self._resync(node_infos, names, "initial", t0, pending)
+        if names != self.node_names:
+            return self._resync(node_infos, names, "node-set", t0, pending)
+        # BEFORE the zero-dirty early return: pending/nominated pods can
+        # grow the vocab with zero node churn, and serving the resident
+        # tensors then would hand the program stale widths (or an all- -1
+        # topo_pair column for a brand-new topology key)
+        if self.signature() != self.caps:
+            return self._resync(node_infos, names, "vocab-growth", t0,
+                                pending)
+        if self.cycles_since_resync >= self.resync_interval:
+            return self._resync(node_infos, names, "anti-entropy", t0,
+                                pending)
+        dirty = [(i, ni) for i, ni in enumerate(node_infos)
+                 if ni.generation != self.node_gen.get(ni.node_name)]
+        if not dirty:
+            self.cycles_since_resync += 1
+            return self.cluster, DeltaStats(0, False, "", ())
+        if len(dirty) > self.max_delta_frac * max(len(names), 1):
+            return self._resync(node_infos, names, "delta-too-large", t0,
+                                pending)
+        # term-carrying pod churn does NOT force a full resync: the
+        # flattened ExistingTerms rebuild from the term OWNERS alone (a
+        # small subset) and replace wholesale — see _refresh_terms
+        hw = self.hard_pod_affinity_weight
+        terms_dirty = any(
+            self.node_terms.get(ni.node_name)
+            or any(pod_has_terms(pi, hw) for pi in ni.pods)
+            for _, ni in dirty)
+        # intern BEFORE the width check so new strings from dirty nodes
+        # count against the caps the resident tensors were sized with
+        self.builder._intern_node_strings([ni for _, ni in dirty])
+        if self.signature() != self.caps:
+            return self._resync(node_infos, names, "vocab-growth", t0,
+                                pending)
+        a = self.host.arrays
+        MLn = a["_kv_ids"].shape[1]
+        MLp = a["_pod_kv_ids"].shape[1]
+        for _, ni in dirty:
+            if len(ni.node.metadata.labels) + 1 > MLn:
+                return self._resync(node_infos, names, "label-capacity",
+                                    t0, pending)
+            for pi in ni.pods:
+                if len(pi.pod.metadata.labels) > MLp:
+                    return self._resync(node_infos, names,
+                                        "label-capacity", t0, pending)
+
+        # ---- pod-row churn: free EVERY departed row across all dirty
+        # nodes BEFORE scanning for additions — a same-uid pod moving
+        # from a higher- to a lower-indexed dirty node would otherwise be
+        # skipped by the add scan (stale mapping still present) and then
+        # popped by the later free, leaving the refill with no row
+        touched_pods: set = set()
+        adds: List[Tuple[object, int]] = []    # (PodInfo, node row)
+        for _, ni in dirty:
+            old = self.node_pods.get(ni.node_name, [])
+            new_set = {pi.pod.uid for pi in ni.pods}
+            for uid in old:
+                if uid not in new_set:
+                    row = self.pod_row.pop(uid)
+                    clear_pod_row(a, row)
+                    touched_pods.add(row)
+                    self.free_rows.append(row)
+        for i, ni in dirty:
+            for pi in ni.pods:
+                if pi.pod.uid not in self.pod_row:
+                    adds.append((pi, i))
+        self.free_rows.sort()
+        PP = a["pod_node"].shape[0]
+        need = len(adds) - len(self.free_rows)
+        grown = False
+        if need > 0 and self.next_pod_row + need > PP:
+            self._grow_pod_axis(self.next_pod_row + need)
+            grown = True
+            PP = a["pod_node"].shape[0]
+        for pi, n_idx in adds:
+            row = (self.free_rows.pop(0) if self.free_rows
+                   else self.next_pod_row)
+            if row == self.next_pod_row:
+                self.next_pod_row += 1
+            self.pod_row[pi.pod.uid] = row
+
+        # ---- refill the mirror rows (node + every pod on a dirty node —
+        # covers in-place pod updates without per-pod generations)
+        t = self.builder.table
+        # a dirty node can have interned a NEW taint inside the cap: the
+        # [T] vocab-metadata rows for fresh ids must land too (build()
+        # fills them from the vocab; ids are append-only, so only the
+        # tail can be stale)
+        from ..api import types as api
+        for ti in range(len(t.taint)):
+            if not a["taint_is_hard"][ti] and not a["taint_is_prefer"][ti]:
+                _, _, effect = t.taint.key(ti)
+                a["taint_is_hard"][ti] = effect in (
+                    api.TAINT_EFFECT_NO_SCHEDULE,
+                    api.TAINT_EFFECT_NO_EXECUTE)
+                a["taint_is_prefer"][ti] = (
+                    effect == api.TAINT_EFFECT_PREFER_NO_SCHEDULE)
+        image_nodes = a["_image_nodes"]
+        node_rows = []
+        for i, ni in dirty:
+            old_imgs = set(np.nonzero(a["images"][i])[0].tolist())
+            fill_node_row(a, i, ni, t)
+            new_imgs = set(np.nonzero(a["images"][i])[0].tolist())
+            for ii in old_imgs - new_imgs:
+                image_nodes[ii] -= 1
+            for ii in new_imgs - old_imgs:
+                image_nodes[ii] += 1
+            for pi in ni.pods:
+                row = self.pod_row[pi.pod.uid]
+                fill_pod_row(a, row, pi, i, t)
+                touched_pods.add(row)
+            self.node_pods[ni.node_name] = [pi.pod.uid for pi in ni.pods]
+            self.node_terms[ni.node_name] = any(pod_has_terms(pi, hw)
+                                                for pi in ni.pods)
+            self.node_gen[ni.node_name] = ni.generation
+            node_rows.append(i)
+        # images that no node carries anymore read 0 in a fresh build
+        a["image_size"][image_nodes <= 0] = 0.0
+        a["image_spread"] = image_nodes / max(float(len(node_infos)), 1.0)
+
+        term_span = ()
+        if terms_dirty:
+            t_terms = time.time()
+            self._refresh_terms(node_infos)
+            term_span = (("delta-terms", t_terms, time.time()),)
+
+        pod_rows = sorted(touched_pods)
+        if grown:
+            # the pod axis changed shape: scatter can't grow a buffer, so
+            # re-upload the (already-updated) mirror — no build() walk
+            self.cycles_since_resync = 0
+            self.resync_count += 1
+            t_build = time.time()
+            self._upload()
+            return self.cluster, DeltaStats(
+                len(node_rows) + len(pod_rows), True, "pod-axis-growth",
+                (("delta-build", t0, t_build),) + term_span
+                + (("resync", t_build, time.time()),))
+        delta = gather_delta(self.host, node_rows, pod_rows)
+        t_build = time.time()
+        self.cluster = self._apply(delta, donate=donate,
+                                   replace_terms=terms_dirty)
+        self.cycles_since_resync += 1
+        return self.cluster, DeltaStats(
+            len(node_rows) + len(pod_rows), False, "",
+            (("delta-build", t0, t_build),) + term_span
+            + (("delta-apply", t_build, time.time()),))
+
+    # ------------------------------------------------------------- resync
+
+    def _resync(self, node_infos, names: List[str], reason: str,
+                t0: float, pending=()):
+        """The blessed full rebuild: anti-entropy resync + every fallback
+        trigger.  Also the vocab COMPACTION point: everything re-derives
+        here, so intern ids are free to move and the table restarts FRESH
+        — without this, dead label values (pod-template-hash churn across
+        rollouts) would grow the vocab, and so the resident tensor
+        widths, without bound.  Ids only need stability BETWEEN resyncs
+        (the delta path's contract).  pending: this cycle's pending/
+        nominated PodInfos, re-interned into the fresh table before
+        sizing so batch tensors and cluster tensors agree on widths."""
+        self.builder = SnapshotBuilder(
+            hard_pod_affinity_weight=self.hard_pod_affinity_weight)
+        if pending:
+            self.builder.intern_pending(pending)
+        host = self.builder.build(node_infos)
+        a = host.arrays
+        self.host = host
+        self.node_names = list(names)
+        self.node_gen = {ni.node_name: ni.generation for ni in node_infos}
+        self.node_pods = {ni.node_name: [pi.pod.uid for pi in ni.pods]
+                          for ni in node_infos}
+        hw = self.hard_pod_affinity_weight
+        self.node_terms = {ni.node_name: any(pod_has_terms(pi, hw)
+                                             for pi in ni.pods)
+                           for ni in node_infos}
+        self.pod_row = dict(a["_pod_rows"])
+        self.next_pod_row = len(self.pod_row)
+        self.free_rows = []
+        self.caps = self.signature()
+        self.cycles_since_resync = 0
+        self.resync_count += 1
+        self._upload()
+        return self.cluster, DeltaStats(
+            0, True, reason, (("resync", t0, time.time()),))
+
+    def _grow_pod_axis(self, needed: int) -> None:
+        """Pad the mirror's pod-axis arrays to the next pow2 bucket —
+        freed-row reuse keeps rows stable, so growth only appends
+        padding rows identical to a fresh build's."""
+        a = self.host.arrays
+        PP = a["pod_node"].shape[0]
+        new_pp = pow2_bucket(needed, 8)
+        n = new_pp - PP
+        if n <= 0:
+            return
+        for field, fill in _POD_FIELDS:
+            arr = a[field]
+            pad = np.full((n,) + arr.shape[1:], fill, arr.dtype)
+            a[field] = np.concatenate([arr, pad])
+
+    def _upload(self) -> None:
+        """Full host→device transfer of the mirror (resync / pod-axis
+        growth); sharded when a mesh is configured so the resident
+        tensors live pre-sharded across cycles."""
+        cluster = self.host.to_device()
+        if self.mesh is not None:
+            from ..parallel import mesh as pmesh
+            cluster = pmesh.shard_cluster(cluster, self.mesh)
+        self.cluster = cluster
+
+    def _refresh_terms(self, node_infos) -> None:
+        """Term-only rebuild: walk the term OWNERS (a small subset of the
+        existing pods), recompile the flattened ExistingTerms against the
+        persistent table, and stage them in the mirror for wholesale
+        replacement — the owner collection follows the same node-walk
+        order as build(), so row content matches a rebuild exactly (term
+        pod_idx points at the stable delta rows).  This demotes
+        "topology-term structural change" from a full-resync trigger to a
+        bounded partial rebuild."""
+        hw = self.hard_pod_affinity_weight
+        filter_owners, score_owners = [], []
+        for ni in node_infos:
+            for pi in ni.pods:
+                row = self.pod_row[pi.pod.uid]
+                if pi.required_anti_affinity_terms:
+                    filter_owners.append((pi, row))
+                if (pi.preferred_affinity_terms
+                        or pi.preferred_anti_affinity_terms
+                        or pi.required_affinity_terms):
+                    score_owners.append((pi, row))
+        a = self.host.arrays
+        a["filter_terms"] = self.builder._build_terms(filter_owners,
+                                                      kind="filter")
+        a["score_terms"] = self.builder._build_terms(score_owners,
+                                                     kind="score")
+
+    def _device_terms(self):
+        """The mirror's term tensors as device (mesh: replicated) arrays —
+        terms replace wholesale, no scatter needed."""
+        import jax
+        import jax.numpy as jnp
+        a = self.host.arrays
+        ft = jax.tree.map(jnp.asarray, a["filter_terms"])
+        st = jax.tree.map(jnp.asarray, a["score_terms"])
+        if self.mesh is not None:
+            from ..parallel import mesh as pmesh
+            ft = pmesh.replicate(ft, self.mesh)
+            st = pmesh.replicate(st, self.mesh)
+        return ft, st
+
+    def _apply(self, delta: ClusterDelta, donate: bool,
+               replace_terms: bool = False):
+        from ..models import programs
+        cluster = self.cluster
+        if replace_terms:
+            # swap the term pytrees BEFORE the jit call: the scatter
+            # program passes terms through untouched, and a donated
+            # pass-through of the OLD terms would invalidate buffers the
+            # new cluster no longer uses anyway
+            ft, st = self._device_terms()
+            cluster = cluster._replace(filter_terms=ft, score_terms=st)
+        if self.mesh is not None:
+            from ..parallel import mesh as pmesh
+            return pmesh.sharded_apply_cluster_delta(
+                cluster, delta, self.mesh, donate=donate)
+        return programs.apply_cluster_delta(cluster, delta, donate=donate)

@@ -314,55 +314,13 @@ class SnapshotBuilder:
             node = ni.node
             if node is None:
                 continue
-            d["node_valid"][n_idx] = True
-            d["unschedulable"][n_idx] = node.spec.unschedulable
-            d["allocatable"][n_idx] = resource_to_channels(ni.allocatable, t, R)
-            req = resource_to_channels(ni.requested, t, R)
-            req[CH_PODS] = len(ni.pods)
-            d["requested"][n_idx] = req
-            d["nonzero_requested"][n_idx, 0] = ni.non_zero_requested.milli_cpu
-            d["nonzero_requested"][n_idx, 1] = ni.non_zero_requested.memory / MIB
-            labels = dict(node.metadata.labels)
-            labels[FIELD_PREFIX + "metadata.name"] = node.name
-            for li, (k, v) in enumerate(labels.items()):
-                d["_kv_ids"][n_idx, li] = t.kv.get((k, v))
-                ki = t.key.get(k)
-                d["keymask"][n_idx, ki] = True
-                try:
-                    d["num"][n_idx, ki] = float(int(v))
-                except ValueError:
-                    pass
-            for tk_i in range(len(t.topokey)):
-                tk = t.topokey.key(tk_i)
-                if tk in labels:
-                    d["topo_pair"][n_idx, tk_i] = t.kv.get((tk, labels[tk]))
-            for taint in node.spec.taints:
-                d["taints"][n_idx, t.taint.get((taint.key, taint.value, taint.effect))] = True
-            for triple in ni.used_ports:
-                for pid in _port_ids_node(triple):
-                    d["ports"][n_idx, t.port.get(pid)] = True
-            for name, size in ni.image_states.items():
-                ii = t.image.get(_norm_image(name))
-                d["images"][n_idx, ii] = True
-                d["image_size"][ii] = size
+            fill_node_row(d, n_idx, ni, t)
             for ii in np.nonzero(d["images"][n_idx])[0]:
                 image_nodes[ii] += 1
-            for kind, uid in _avoid_entries(node):
-                d["avoid_hot"][n_idx, t.avoid.get((kind, uid))] = True
-            zk = zone_key(node)
-            if zk:
-                d["zone_hot"][n_idx, t.zone.get(zk)] = 1.0
 
             for pi in ni.pods:
-                p = pi.pod
-                d["pod_node"][pod_row] = n_idx
-                d["pod_valid"][pod_row] = True
-                d["pod_terminating"][pod_row] = p.metadata.deletion_timestamp is not None
-                d["pod_ns_hot"][pod_row, t.ns.get(p.namespace)] = 1.0
-                for li, (k, v) in enumerate(p.metadata.labels.items()):
-                    d["_pod_kv_ids"][pod_row, li] = t.kv.get((k, v))
-                    d["pod_key"][pod_row, t.key.get(k)] = True
-                pod_rows[p.uid] = pod_row
+                fill_pod_row(d, pod_row, pi, n_idx, t)
+                pod_rows[pi.pod.uid] = pod_row
                 if pi.required_anti_affinity_terms:
                     filter_owners.append((pi, pod_row))
                 if (pi.preferred_affinity_terms or pi.preferred_anti_affinity_terms
@@ -375,6 +333,11 @@ class SnapshotBuilder:
 
         d["filter_terms"] = self._build_terms(filter_owners, kind="filter")
         d["score_terms"] = self._build_terms(score_owners, kind="score")
+        # delta-maintenance metadata (state/delta.py DeltaTensorizer):
+        # stable row assignments + per-image node counts, so incremental
+        # updates can start exactly where this build left off
+        d["_pod_rows"] = pod_rows
+        d["_image_nodes"] = image_nodes
         return HostClusterArrays(arrays=d)
 
     def _build_terms(self, owners: List[Tuple[PodInfo, int]], kind: str) -> ExistingTerms:
@@ -420,6 +383,206 @@ class SnapshotBuilder:
             valid[i] = True
         return ExistingTerms(sel=sel_set, ns_hot=ns_hot, topo_key=topo_key,
                              pod_idx=pod_idx, weight=weight, valid=valid)
+
+
+# --------------------------------------------------------------------------
+# Per-row fills, shared by SnapshotBuilder.build (the from-scratch walk) and
+# state/delta.py DeltaTensorizer (the incremental path).  Bit-exactness
+# contract: filling a row through these helpers produces byte-identical
+# arrays to a fresh build of the same NodeInfo against the same InternTable,
+# so delta-maintained tensors never drift from a rebuild.
+
+
+def fill_node_row(d: dict, n_idx: int, ni: NodeInfo, t: InternTable) -> None:
+    """(Re)fill every node-axis array row for one NodeInfo.  Clears the row
+    first so refilling a previously-populated row (the delta path) leaves
+    no stale label/taint/port bits behind."""
+    node = ni.node
+    R = d["allocatable"].shape[1]
+    d["node_valid"][n_idx] = True
+    d["unschedulable"][n_idx] = node.spec.unschedulable
+    d["_kv_ids"][n_idx] = -1
+    d["keymask"][n_idx] = False
+    d["num"][n_idx] = np.inf
+    d["topo_pair"][n_idx] = -1
+    d["taints"][n_idx] = False
+    d["ports"][n_idx] = False
+    d["images"][n_idx] = False
+    d["avoid_hot"][n_idx] = False
+    d["zone_hot"][n_idx] = 0.0
+    d["allocatable"][n_idx] = resource_to_channels(ni.allocatable, t, R)
+    req = resource_to_channels(ni.requested, t, R)
+    req[CH_PODS] = len(ni.pods)
+    d["requested"][n_idx] = req
+    d["nonzero_requested"][n_idx, 0] = ni.non_zero_requested.milli_cpu
+    d["nonzero_requested"][n_idx, 1] = ni.non_zero_requested.memory / MIB
+    labels = dict(node.metadata.labels)
+    labels[FIELD_PREFIX + "metadata.name"] = node.name
+    for li, (k, v) in enumerate(labels.items()):
+        d["_kv_ids"][n_idx, li] = t.kv.get((k, v))
+        ki = t.key.get(k)
+        d["keymask"][n_idx, ki] = True
+        try:
+            d["num"][n_idx, ki] = float(int(v))
+        except ValueError:
+            pass
+    for tk_i in range(len(t.topokey)):
+        tk = t.topokey.key(tk_i)
+        if tk in labels:
+            d["topo_pair"][n_idx, tk_i] = t.kv.get((tk, labels[tk]))
+    for taint in node.spec.taints:
+        d["taints"][n_idx, t.taint.get((taint.key, taint.value,
+                                        taint.effect))] = True
+    for triple in ni.used_ports:
+        for pid in _port_ids_node(triple):
+            d["ports"][n_idx, t.port.get(pid)] = True
+    for name, size in ni.image_states.items():
+        ii = t.image.get(_norm_image(name))
+        d["images"][n_idx, ii] = True
+        d["image_size"][ii] = size
+    for kind, uid in _avoid_entries(node):
+        d["avoid_hot"][n_idx, t.avoid.get((kind, uid))] = True
+    zk = zone_key(node)
+    if zk:
+        d["zone_hot"][n_idx, t.zone.get(zk)] = 1.0
+
+
+def fill_pod_row(d: dict, row: int, pi: PodInfo, n_idx: int,
+                 t: InternTable) -> None:
+    """(Re)fill one existing-pod row.  Clears first (delta row reuse)."""
+    clear_pod_row(d, row)
+    p = pi.pod
+    d["pod_node"][row] = n_idx
+    d["pod_valid"][row] = True
+    d["pod_terminating"][row] = p.metadata.deletion_timestamp is not None
+    d["pod_ns_hot"][row, t.ns.get(p.namespace)] = 1.0
+    for li, (k, v) in enumerate(p.metadata.labels.items()):
+        d["_pod_kv_ids"][row, li] = t.kv.get((k, v))
+        d["pod_key"][row, t.key.get(k)] = True
+
+
+def clear_pod_row(d: dict, row: int) -> None:
+    """Reset a pod row to build-time defaults (an evicted pod's freed row
+    must be byte-identical to a fresh build's padding row)."""
+    d["pod_node"][row] = -1
+    d["pod_valid"][row] = False
+    d["pod_terminating"][row] = False
+    d["pod_ns_hot"][row] = 0.0
+    d["_pod_kv_ids"][row] = -1
+    d["pod_key"][row] = False
+
+
+def vocab_signature(table: InternTable) -> tuple:
+    """Every width the cluster tensors are sized with: each vocab's pow2
+    cap (zone included) plus the topokey LENGTH — ``topo_pair`` columns
+    are filled from the key LIST at build time, so topokey growth inside
+    the cap still invalidates built tensors.  The ONE signature both
+    resident-state guards compare (the scheduler's gang chain and the
+    DeltaTensorizer): a vocab added here invalidates both, never one."""
+    caps = tuple((n, getattr(table, n).cap) for n in
+                 ("kv", "key", "ns", "topokey", "rname", "port", "taint",
+                  "image", "avoid", "zone"))
+    return caps + (("topokey_len", len(table.topokey)),)
+
+
+def pod_has_terms(pi: PodInfo, hard_pod_affinity_weight: int = 1) -> bool:
+    """True when this existing pod contributes rows to filter_terms or
+    score_terms — the delta path resyncs when such a pod churns, because
+    the flattened term tensors are only rebuilt on a full build()."""
+    return bool(pi.required_anti_affinity_terms
+                or pi.preferred_affinity_terms
+                or pi.preferred_anti_affinity_terms
+                or (hard_pod_affinity_weight and pi.required_affinity_terms))
+
+
+class ClusterDelta(NamedTuple):
+    """Compact [D]-indexed update tables for one cycle's dirty rows,
+    applied on device by models/programs.py apply_cluster_delta
+    (``x.at[rows].set(..., mode="drop")``).  Row vectors are padded to a
+    pow2 bucket with ONE-PAST-CAPACITY indices (N for node rows, P for pod
+    rows): "drop" mode discards out-of-bounds scatters, while a -1 pad
+    would WRAP to the last row and corrupt it.  Label one-hots ride as
+    compact id lists ([D, ML] i32) and densify on device, mirroring the
+    HostClusterArrays transfer contract.  The two [I] image vectors are
+    cluster-global (spread is a fraction of all nodes) and tiny, so every
+    delta replaces them wholesale."""
+    node_rows: np.ndarray          # [Dn] i32 (pad = N: dropped)
+    allocatable: np.ndarray        # [Dn, R] f32
+    requested: np.ndarray          # [Dn, R] f32
+    nonzero_requested: np.ndarray  # [Dn, 2] f32
+    node_valid: np.ndarray         # [Dn] bool
+    unschedulable: np.ndarray      # [Dn] bool
+    kv_ids: np.ndarray             # [Dn, MLn] i32 (densified on device)
+    keymask: np.ndarray            # [Dn, K] bool
+    num: np.ndarray                # [Dn, K] f32
+    topo_pair: np.ndarray          # [Dn, TK] i32
+    taints: np.ndarray             # [Dn, T] bool
+    ports: np.ndarray              # [Dn, P] bool
+    images: np.ndarray             # [Dn, I] bool
+    avoid_hot: np.ndarray          # [Dn, AV] bool
+    zone_hot: np.ndarray           # [Dn, Z] f32
+    image_size: np.ndarray         # [I] f32 (full replace)
+    image_spread: np.ndarray       # [I] f32 (full replace)
+    taint_is_hard: np.ndarray      # [T] bool (full replace: a dirty node
+                                   # can intern a NEW taint inside the cap)
+    taint_is_prefer: np.ndarray    # [T] bool (full replace)
+    pod_rows: np.ndarray           # [Dp] i32 (pad = P: dropped)
+    pod_kv_ids: np.ndarray         # [Dp, MLp] i32 (densified on device)
+    pod_key: np.ndarray            # [Dp, K] bool
+    pod_ns_hot: np.ndarray         # [Dp, NS] f32
+    pod_node: np.ndarray           # [Dp] i32
+    pod_valid: np.ndarray          # [Dp] bool
+    pod_terminating: np.ndarray    # [Dp] bool
+
+
+def gather_delta(host: HostClusterArrays, node_rows: List[int],
+                 pod_rows: List[int]) -> ClusterDelta:
+    """Slice the dirty rows out of the host mirror into pow2-bucketed
+    update tables (the host half of the delta pipeline)."""
+    a = host.arrays
+    N = a["allocatable"].shape[0]
+    PP = a["pod_node"].shape[0]
+    Dn = pow2_bucket(len(node_rows), 8)
+    Dp = pow2_bucket(len(pod_rows), 8)
+    nr = np.full((Dn,), N, np.int32)
+    nr[:len(node_rows)] = node_rows
+    pr = np.full((Dp,), PP, np.int32)
+    pr[:len(pod_rows)] = pod_rows
+
+    def g(field: str, rows: List[int], cap: int) -> np.ndarray:
+        arr = a[field]
+        out = np.zeros((cap,) + arr.shape[1:], arr.dtype)
+        if rows:
+            out[:len(rows)] = arr[rows]
+        return out
+
+    return ClusterDelta(
+        node_rows=nr,
+        allocatable=g("allocatable", node_rows, Dn),
+        requested=g("requested", node_rows, Dn),
+        nonzero_requested=g("nonzero_requested", node_rows, Dn),
+        node_valid=g("node_valid", node_rows, Dn),
+        unschedulable=g("unschedulable", node_rows, Dn),
+        kv_ids=g("_kv_ids", node_rows, Dn),
+        keymask=g("keymask", node_rows, Dn),
+        num=g("num", node_rows, Dn),
+        topo_pair=g("topo_pair", node_rows, Dn),
+        taints=g("taints", node_rows, Dn),
+        ports=g("ports", node_rows, Dn),
+        images=g("images", node_rows, Dn),
+        avoid_hot=g("avoid_hot", node_rows, Dn),
+        zone_hot=g("zone_hot", node_rows, Dn),
+        image_size=a["image_size"].copy(),
+        image_spread=np.asarray(a["image_spread"], np.float32).copy(),
+        taint_is_hard=a["taint_is_hard"].copy(),
+        taint_is_prefer=a["taint_is_prefer"].copy(),
+        pod_rows=pr,
+        pod_kv_ids=g("_pod_kv_ids", pod_rows, Dp),
+        pod_key=g("pod_key", pod_rows, Dp),
+        pod_ns_hot=g("pod_ns_hot", pod_rows, Dp),
+        pod_node=g("pod_node", pod_rows, Dp),
+        pod_valid=g("pod_valid", pod_rows, Dp),
+        pod_terminating=g("pod_terminating", pod_rows, Dp))
 
 
 def _norm_image(name: str) -> str:

@@ -1,0 +1,601 @@
+"""Incremental delta-tensorization suite (state/delta.py, `make delta-test`):
+
+  * golden equivalence — after randomized commit/evict/update sequences,
+    the delta-applied device ClusterTensors bit-match a from-scratch
+    ``SnapshotBuilder.build()`` of the same NodeInfos against the same
+    InternTable, up to the documented stable-row permutation of the
+    existing-pod axis (fresh builds pack pods in node-walk order; the
+    delta path keeps rows stable and reuses freed rows lowest-first);
+  * fallback triggers — intern-table growth, term-carrying pod churn,
+    node-set changes and pod-axis exhaustion all take the blessed resync
+    path and still land on golden state;
+  * the zero-delta chain case — an unchanged snapshot returns the SAME
+    resident cluster object with delta_rows == 0;
+  * compile-once watchdog — a 50-cycle delta drain compiles the scatter
+    program at most once per pow2 bucket (utils/sanitize.py);
+  * the serving loop — a multi-cycle gang drain with chaining OFF runs
+    ONE full build (the initial resync) and scatters the rest;
+  * bench satellites — the NORTHSTAR drift gate and the single-point
+    compile_s clamp (BENCH_r05's chain_on case reported -0.3).
+"""
+
+import copy
+import random
+
+import numpy as np
+import pytest
+
+from kubetpu.api import types as api
+from kubetpu.harness import hollow
+from kubetpu.state.cache import SchedulerCache, Snapshot
+from kubetpu.state.delta import DeltaTensorizer
+from kubetpu.state.tensors import SnapshotBuilder
+
+NODE_AXIS_AND_VOCAB = [
+    "allocatable", "requested", "nonzero_requested", "node_valid",
+    "unschedulable", "kv", "keymask", "num", "topo_pair", "taints",
+    "ports", "images", "avoid_hot", "zone_hot", "taint_is_hard",
+    "taint_is_prefer", "image_size", "image_spread"]
+POD_AXIS = ["pod_kv", "pod_key", "pod_ns_hot", "pod_node", "pod_valid",
+            "pod_terminating"]
+
+
+def snapshot_of(cache):
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+    return snap.node_info_list
+
+
+def assert_matches_fresh(dt: DeltaTensorizer, node_infos) -> None:
+    """The golden assertion: the resident device tensors equal a fresh
+    build() against a COPY of the persistent intern table (ids fixed),
+    bit-for-bit — node axis directly, pod axis under the uid-row
+    permutation, remaining delta rows at build defaults."""
+    fresh_b = SnapshotBuilder(
+        table=copy.deepcopy(dt.builder.table),
+        hard_pod_affinity_weight=dt.hard_pod_affinity_weight)
+    fresh_host = fresh_b.build(node_infos)
+    fresh = fresh_host.to_device()
+    got = dt.cluster
+    for f in NODE_AXIS_AND_VOCAB:
+        a, b = np.asarray(getattr(got, f)), np.asarray(getattr(fresh, f))
+        assert a.shape == b.shape, (f, a.shape, b.shape)
+        assert np.array_equal(a, b), (
+            f, np.argwhere(a != b)[:5] if a.shape == b.shape else None)
+    drow, frow = dt.pod_row, fresh_host.arrays["_pod_rows"]
+    assert set(drow) == set(frow)
+    gotp = {f: np.asarray(getattr(got, f)) for f in POD_AXIS}
+    frep = {f: np.asarray(getattr(fresh, f)) for f in POD_AXIS}
+    for uid in drow:
+        for f in POD_AXIS:
+            assert np.array_equal(gotp[f][drow[uid]], frep[f][frow[uid]]), (
+                uid, f)
+    used = set(drow.values())
+    for r in range(gotp["pod_valid"].shape[0]):
+        if r not in used:
+            assert not gotp["pod_valid"][r], r
+            assert gotp["pod_node"][r] == -1, r
+    # term tensors: owner collection follows the same node-walk order in
+    # both paths, so every leaf matches directly EXCEPT pod_idx, which
+    # points at rows — compare it through the uid permutation
+    import jax
+    inv_d = {r: u for u, r in drow.items()}
+    inv_f = {r: u for u, r in frow.items()}
+    for kind in ("filter_terms", "score_terms"):
+        dterm, fterm = getattr(got, kind), getattr(fresh, kind)
+        for leaf in ("ns_hot", "topo_key", "weight", "valid"):
+            a = np.asarray(getattr(dterm, leaf))
+            b = np.asarray(getattr(fterm, leaf))
+            assert np.array_equal(a, b), (kind, leaf)
+        for a, b in zip(jax.tree.leaves(dterm.sel),
+                        jax.tree.leaves(fterm.sel)):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), (kind,
+                                                                  "sel")
+        dp, fp = np.asarray(dterm.pod_idx), np.asarray(fterm.pod_idx)
+        valid = np.asarray(dterm.valid)
+        for i in np.nonzero(valid)[0]:
+            assert inv_d[int(dp[i])] == inv_f[int(fp[i])], (kind, i)
+
+
+def build_cache(n_nodes=6, pods_per_node=2, zones=3):
+    cache = SchedulerCache()
+    nodes = hollow.make_nodes(n_nodes, zones=zones)
+    pods = []
+    for i, n in enumerate(nodes):
+        cache.add_node(n)
+        for p in hollow.make_pods(pods_per_node, prefix=f"ex-{i}-",
+                                  group_labels=3):
+            p.spec.node_name = n.name
+            cache.add_pod(p)
+            pods.append(p)
+    return cache, nodes, pods
+
+
+# ---------------------------------------------------------------------------
+# golden equivalence
+
+
+def test_initial_resync_then_zero_delta():
+    cache, _, _ = build_cache()
+    dt = DeltaTensorizer()
+    infos = snapshot_of(cache)
+    c1, st1 = dt.refresh(infos)
+    assert st1.resync and st1.reason == "initial"
+    assert [n for n, _, _ in st1.spans] == ["resync"]
+    assert_matches_fresh(dt, infos)
+    # unchanged snapshot: the zero-delta chain case — same object, 0 rows
+    c2, st2 = dt.refresh(snapshot_of(cache))
+    assert c2 is c1
+    assert st2.delta_rows == 0 and not st2.resync
+
+
+def test_randomized_churn_stays_golden():
+    """The acceptance golden: randomized commit/evict/update sequences,
+    delta-applied tensors bit-match a rebuild after every cycle."""
+    rng = random.Random(7)
+    cache, nodes, pods = build_cache(n_nodes=8, pods_per_node=2, zones=4)
+    live = list(pods)
+    dt = DeltaTensorizer()
+    dt.refresh(snapshot_of(cache))
+    seq = 0
+    resyncs0 = dt.resync_count
+    for step in range(40):
+        op = rng.choice(["commit", "commit", "commit-term", "evict",
+                         "update-node", "update-pod"])
+        if op in ("commit", "commit-term"):
+            seq += 1
+            p = hollow.make_pod(f"new-{seq}")
+            p.metadata.labels = {"app": f"group-{rng.randrange(3)}"}
+            if op == "commit-term":
+                # term-carrying pods ride the delta path too (term-only
+                # rebuild, no resync)
+                hollow.with_anti_affinity(p)
+            p.spec.node_name = rng.choice(nodes).name
+            cache.add_pod(p)
+            live.append(p)
+        elif op == "evict" and live:
+            cache.remove_pod(live.pop(rng.randrange(len(live))))
+        elif op == "update-node":
+            old = rng.choice(nodes)
+            new = copy.deepcopy(old)
+            new.spec.unschedulable = not old.spec.unschedulable
+            cache.update_node(old, new)
+            nodes[nodes.index(old)] = new
+        elif op == "update-pod" and live:
+            i = rng.randrange(len(live))
+            old = live[i]
+            new = copy.copy(old)
+            new.metadata = copy.deepcopy(old.metadata)
+            new.metadata.labels["app"] = f"group-{rng.randrange(3)}"
+            cache.update_pod(old, new)
+            live[i] = new
+        infos = snapshot_of(cache)
+        _, st = dt.refresh(infos)
+        assert_matches_fresh(dt, infos)
+        if not st.resync:
+            assert st.delta_rows > 0
+        else:
+            # vocab stays inside its caps by construction, so the only
+            # legitimate fallback under this churn is pod-row exhaustion
+            assert st.reason == "pod-axis-growth", st.reason
+    del resyncs0
+
+
+# ---------------------------------------------------------------------------
+# fallback triggers
+
+
+def test_intern_growth_falls_back_to_resync():
+    cache, nodes, _ = build_cache()
+    dt = DeltaTensorizer()
+    dt.refresh(snapshot_of(cache))
+    kv_cap = dt.builder.table.kv.cap
+    seq = 0
+    # churn distinct label VALUES until the kv pow2 bucket doubles
+    while dt.builder.table.kv.cap == kv_cap:
+        seq += 1
+        p = hollow.make_pod(f"grow-{seq}")
+        p.metadata.labels = {"uniq": f"v{seq}"}
+        p.spec.node_name = nodes[seq % len(nodes)].name
+        cache.add_pod(p)
+        infos = snapshot_of(cache)
+        _, st = dt.refresh(infos)
+        assert_matches_fresh(dt, infos)
+    assert st.resync and st.reason == "vocab-growth"
+
+
+def test_term_pod_churn_is_delta_served_with_term_refresh():
+    """Term-carrying pod churn no longer forces a full resync: the
+    ExistingTerms rebuild from the term OWNERS alone (delta-terms span)
+    and the rest of the cycle stays on the scatter path — bit-exact
+    against a rebuild both after the add and after the evict."""
+    cache, nodes, _ = build_cache()
+    dt = DeltaTensorizer()
+    dt.refresh(snapshot_of(cache))
+    resyncs0 = dt.resync_count
+    p = hollow.make_pod("affinity-pod")
+    hollow.with_anti_affinity(p)
+    p.spec.node_name = nodes[0].name
+    cache.add_pod(p)
+    infos = snapshot_of(cache)
+    _, st = dt.refresh(infos)
+    assert not st.resync, st.reason
+    assert "delta-terms" in [n for n, _, _ in st.spans]
+    assert_matches_fresh(dt, infos)
+    # REMOVING the term pod drops its term rows, still without a resync
+    cache.remove_pod(p)
+    infos = snapshot_of(cache)
+    _, st = dt.refresh(infos)
+    assert not st.resync, st.reason
+    assert "delta-terms" in [n for n, _, _ in st.spans]
+    assert_matches_fresh(dt, infos)
+    assert dt.resync_count == resyncs0
+
+
+def test_pending_vocab_growth_resyncs_even_with_zero_node_churn():
+    """Review regression: pending/nominated pods intern BEFORE the dirty
+    scan, so a cycle with zero node churn whose pending pod carries a
+    never-seen topology key must still resync — serving the resident
+    tensors would leave the new topo_pair column all -1 (every node
+    silently 'lacks' the key)."""
+    from kubetpu.framework.types import PodInfo
+    cache, _, _ = build_cache()
+    dt = DeltaTensorizer()
+    infos = snapshot_of(cache)
+    dt.refresh(infos)
+    p = hollow.make_pod("pending-new-key")
+    hollow.with_spread(p, "custom.io/rack")
+    _, st = dt.refresh(infos, pending=[PodInfo(p)])
+    assert st.resync and st.reason == "vocab-growth"
+    assert_matches_fresh(dt, infos)
+    # same pending pod next cycle: strings already in the (fresh) table
+    _, st = dt.refresh(infos, pending=[PodInfo(p)])
+    assert not st.resync and st.delta_rows == 0
+
+
+def test_resync_compacts_dead_vocab():
+    """A full resync restarts the intern table: label values of departed
+    pods (pod-template-hash churn) stop occupying vocab — and so resident
+    tensor width — forever."""
+    cache, nodes, _ = build_cache()
+    dt = DeltaTensorizer()
+    dt.refresh(snapshot_of(cache))
+    base_len = len(dt.builder.table.kv)
+    doomed = []
+    for i in range(40):
+        p = hollow.make_pod(f"churn-{i}")
+        p.metadata.labels = {"rollout-hash": f"h{i:04d}"}
+        p.spec.node_name = nodes[i % len(nodes)].name
+        cache.add_pod(p)
+        doomed.append(p)
+    infos = snapshot_of(cache)
+    dt.refresh(infos)
+    grown_len = len(dt.builder.table.kv)
+    assert grown_len >= base_len + 40
+    for p in doomed:
+        cache.remove_pod(p)
+    infos = snapshot_of(cache)
+    dt.refresh(infos)
+    # force the anti-entropy resync: the compaction point
+    dt.cycles_since_resync = dt.resync_interval
+    _, st = dt.refresh(infos)
+    assert st.resync and st.reason == "anti-entropy"
+    assert len(dt.builder.table.kv) < grown_len - 30
+    assert_matches_fresh(dt, infos)
+
+
+def test_pod_moving_to_lower_indexed_node_keeps_its_row_mapping():
+    """Review regression: a same-uid pod moving from a higher- to a
+    lower-indexed node between refreshes must be freed across ALL dirty
+    nodes before the add scan — the interleaved single-pass version saw
+    the stale mapping on the destination node, skipped the add, then
+    popped the row and crashed the refill with a KeyError."""
+    cache, nodes, pods = build_cache()
+    dt = DeltaTensorizer()
+    dt.refresh(snapshot_of(cache))
+    mover = pods[-1]                      # lives on the LAST node
+    cache.remove_pod(mover)
+    moved = copy.copy(mover)
+    moved.spec = copy.copy(mover.spec)
+    moved.spec.node_name = nodes[0].name  # re-added on the FIRST node
+    cache.add_pod(moved)
+    infos = snapshot_of(cache)
+    _, st = dt.refresh(infos)
+    assert not st.resync, st.reason
+    assert_matches_fresh(dt, infos)
+
+
+def test_node_set_change_falls_back_to_resync():
+    cache, nodes, _ = build_cache()
+    dt = DeltaTensorizer()
+    dt.refresh(snapshot_of(cache))
+    cache.add_node(hollow.make_node("late-node", zone="zone-0"))
+    infos = snapshot_of(cache)
+    _, st = dt.refresh(infos)
+    assert st.resync and st.reason == "node-set"
+    assert_matches_fresh(dt, infos)
+
+
+def test_pod_axis_growth_reuploads_without_build(monkeypatch):
+    """Pod-row exhaustion pads the mirror to the next pow2 bucket and
+    re-uploads — WITHOUT re-running the build() walk."""
+    from kubetpu.state import tensors as tensors_mod
+    cache, nodes, _ = build_cache(n_nodes=4, pods_per_node=2, zones=2)
+    dt = DeltaTensorizer()
+    dt.refresh(snapshot_of(cache))
+    pp0 = dt.host.arrays["pod_node"].shape[0]
+    builds = [0]
+    orig = tensors_mod.SnapshotBuilder.build
+
+    def counted(self, *a, **kw):
+        builds[0] += 1
+        return orig(self, *a, **kw)
+    monkeypatch.setattr(tensors_mod.SnapshotBuilder, "build", counted)
+    seq = 0
+    while dt.host.arrays["pod_node"].shape[0] == pp0:
+        seq += 1
+        p = hollow.make_pod(f"fill-{seq}")
+        p.metadata.labels = {"app": "group-0"}
+        p.spec.node_name = nodes[seq % len(nodes)].name
+        cache.add_pod(p)
+        infos = snapshot_of(cache)
+        before = builds[0]        # assert_matches_fresh builds on purpose;
+        _, st = dt.refresh(infos)  # the REFRESH itself must not
+        assert builds[0] == before, "pod-axis growth re-walked the world"
+        assert_matches_fresh(dt, infos)
+    assert st.resync and st.reason == "pod-axis-growth"
+
+
+def test_anti_entropy_resync_interval():
+    cache, nodes, _ = build_cache()
+    dt = DeltaTensorizer(resync_interval=3)
+    dt.refresh(snapshot_of(cache))
+    reasons = []
+    for seq in range(5):
+        p = hollow.make_pod(f"tick-{seq}")
+        p.metadata.labels = {"app": "group-0"}
+        p.spec.node_name = nodes[0].name
+        cache.add_pod(p)
+        _, st = dt.refresh(snapshot_of(cache))
+        reasons.append(st.reason)
+    assert "anti-entropy" in reasons
+
+
+# ---------------------------------------------------------------------------
+# compile-once contract
+
+
+def test_delta_drain_compiles_scatter_once_per_bucket():
+    """50-cycle delta drain under the sanitize watchdog: the scatter
+    program (apply_cluster_delta) compiles AT MOST once per pow2 bucket
+    — same-bucket deltas are pure jit-cache hits."""
+    from kubetpu.utils.sanitize import sanitized
+
+    cache, nodes, pods = build_cache(n_nodes=6, pods_per_node=2, zones=3)
+    rng = random.Random(3)
+    live = list(pods)
+    with sanitized() as wd:
+        dt = DeltaTensorizer(resync_interval=1000)
+        dt.refresh(snapshot_of(cache))
+        for seq in range(50):
+            # alternate adds/removes so the pod axis never grows: every
+            # cycle touches 1-2 nodes -> one [Dn=8, Dp=8] bucket
+            if seq % 2 == 0 or not live:
+                p = hollow.make_pod(f"cyc-{seq}")
+                p.metadata.labels = {"app": f"group-{rng.randrange(3)}"}
+                p.spec.node_name = rng.choice(nodes).name
+                cache.add_pod(p)
+                live.append(p)
+            else:
+                cache.remove_pod(live.pop(rng.randrange(len(live))))
+            _, st = dt.refresh(snapshot_of(cache))
+            assert not st.resync, st.reason
+        apply_compiles = {k: c for k, c in wd.counts.items()
+                         if "apply_cluster_delta" in k[0]}
+        assert apply_compiles, "scatter program never compiled?"
+        for key, count in apply_compiles.items():
+            assert count == 1, (key, count)
+        assert len(apply_compiles) <= 2, apply_compiles
+        wd.assert_no_recompilation()
+
+
+# ---------------------------------------------------------------------------
+# the serving loop rides the delta path
+
+
+def drain(sched, max_cycles=12):
+    out = []
+    for _ in range(max_cycles):
+        got = sched.schedule_pending(timeout=0.0)
+        if not got:
+            break
+        out.extend(got)
+    return out
+
+
+def test_unchained_drain_builds_once(monkeypatch):
+    """A multi-cycle gang drain with chaining OFF — the shape that used
+    to re-tensorize the world every cycle — now runs ONE full build (the
+    initial resync) and serves the rest by scatter."""
+    from kubetpu.apis.config import (KubeSchedulerConfiguration,
+                                     KubeSchedulerProfile)
+    from kubetpu.client.store import ClusterStore
+    from kubetpu.scheduler import Scheduler
+    from kubetpu.state import tensors as tensors_mod
+
+    builds = [0]
+    orig = tensors_mod.SnapshotBuilder.build
+
+    def counted(self, *a, **kw):
+        builds[0] += 1
+        return orig(self, *a, **kw)
+    monkeypatch.setattr(tensors_mod.SnapshotBuilder, "build", counted)
+
+    store = ClusterStore()
+    for n in hollow.make_nodes(8, zones=4):
+        store.add(n)
+    cfg = KubeSchedulerConfiguration(
+        profiles=[KubeSchedulerProfile()], batch_size=8, mode="gang",
+        chain_cycles=False)
+    sched = Scheduler(store, config=cfg, async_binding=False)
+    for p in hollow.make_pods(30, group_labels=4):
+        store.add(p)
+    out = drain(sched)
+    assert len(out) == 30
+    assert all(o.node for o in out), [(o.pod.metadata.name, o.err)
+                                      for o in out if not o.node]
+    # ONE build() walk — the initial resync; later resyncs (pod-axis
+    # growth on the tiny starting bucket) re-upload without a walk
+    assert builds[0] == 1, f"expected ONE initial resync, saw {builds[0]}"
+    assert sched.resync_count >= 1
+    assert len(sched.delta_rows) >= 1
+    assert all(r > 0 for r in sched.delta_rows)
+    sched.close()
+
+
+def test_pipelined_drain_survives_mid_drain_chain_break():
+    """The donation hazard: a pipelined drain has cycle k-1 dispatched but
+    uncommitted when an external event breaks the chain, so cycle k's
+    prepare runs a delta refresh — which must NOT donate the resident
+    buffers k-1's commit-side device work (preemption wave, decision
+    audit) still reads.  A foreign bound pod lands mid-drain; every
+    pending pod must still commit exactly once."""
+    from kubetpu.apis.config import (KubeSchedulerConfiguration,
+                                     KubeSchedulerProfile)
+    from kubetpu.client.store import ClusterStore
+    from kubetpu.scheduler import Scheduler
+
+    store = ClusterStore()
+    for n in hollow.make_nodes(8, zones=4):
+        store.add(n)
+    cfg = KubeSchedulerConfiguration(
+        profiles=[KubeSchedulerProfile()], batch_size=8, mode="gang",
+        chain_cycles=True, pipeline_cycles=True)
+    sched = Scheduler(store, config=cfg, async_binding=False)
+    for p in hollow.make_pods(32, group_labels=4):
+        store.add(p)
+    out = []
+    foreign_landed = False
+    for _ in range(20):
+        got = sched.schedule_pending(timeout=0.0)
+        if not got:
+            break
+        out.extend(got)
+        if not foreign_landed:
+            # a foreign writer binds a pod: chain dirty while a cycle is
+            # in flight -> the next prepare takes the delta path
+            foreign = hollow.make_pod("foreign-bound")
+            foreign.spec.node_name = hollow.make_nodes(8)[3].name
+            store.add(foreign)
+            foreign_landed = True
+    out.extend(sched.flush_pipeline())
+    assert foreign_landed
+    scheduled = [o for o in out if o.node]
+    assert len(out) == 32, len(out)
+    assert len(scheduled) == 32, [(o.pod.metadata.name, o.err)
+                                  for o in out if not o.node]
+    assert len({o.pod.uid for o in out}) == 32, "a pod committed twice"
+    sched.close()
+
+
+def test_flight_recorder_surfaces_delta_spans():
+    from kubetpu.apis.config import (KubeSchedulerConfiguration,
+                                     KubeSchedulerProfile)
+    from kubetpu.client.store import ClusterStore
+    from kubetpu.scheduler import Scheduler
+    from kubetpu.utils import trace as utrace
+
+    fr = utrace.arm_flight_recorder(capacity=16)
+    fr.clear()
+    try:
+        store = ClusterStore()
+        for n in hollow.make_nodes(4, zones=2):
+            store.add(n)
+        sched = Scheduler(store, config=KubeSchedulerConfiguration(
+            profiles=[KubeSchedulerProfile()], batch_size=4, mode="gang",
+            chain_cycles=False), async_binding=False)
+        for p in hollow.make_pods(12, group_labels=2):
+            store.add(p)
+        drain(sched)
+        recs = fr.cycles()
+        assert recs
+        names = [s.name for r in recs for s in r.spans()]
+        assert "resync" in names          # the initial build
+        assert "delta-apply" in names     # later cycles scatter
+        metas = [r.meta for r in recs if "delta_rows" in r.meta]
+        assert metas
+        # resync instants ride the chrome export as ph:"i" events
+        resync_events = [e for r in recs for e in r.events()
+                         if e["name"] == "resync"]
+        assert resync_events and resync_events[0]["args"]["reason"]
+        # traceview's stage table digest line
+        import tools.traceview as tv
+        spans = tv._load_spans(fr.to_pipeline_doc())
+        digest = tv.delta_summary(spans)
+        assert "delta cycles" in digest and "resyncs" in digest
+        assert tv.delta_summary([]) == ""
+        sched.close()
+    finally:
+        utrace.disarm_flight_recorder()
+
+
+# ---------------------------------------------------------------------------
+# bench satellites: compile_s clamp + NORTHSTAR drift gate
+
+
+def test_compile_estimate_clamped_at_zero():
+    """Regression for BENCH_r05's chain_on `compile_s: -0.3`: with the
+    persistent XLA cache the first run can beat the warm best; the single
+    point where compile_s is computed clamps at zero."""
+    import bench
+    assert bench.compile_estimate(2.066, 2.335) == 0.0
+    assert bench.compile_estimate(9.291, 1.866) == 7.4
+    # every reporting path flows through mode_summary -> compile_estimate
+    d, _ = bench.mode_summary("gang", best=2.335, first=2.066,
+                              outcomes=[], sched=None, stats={})
+    assert d["compile_s"] == 0.0
+
+
+def test_northstar_gate_detects_regression(tmp_path):
+    import bench
+    path = tmp_path / "NORTHSTAR.json"
+    path.write_text("""{
+      "gate": {
+        "gang.pods_per_sec": {"pods_per_sec": 1000.0, "min_frac": 0.9},
+        "chain_drain.pipelined.pods_per_sec":
+            {"pods_per_sec": 2000.0, "min_frac": 0.8}
+      }
+    }""")
+    ok = {"gang": {"pods_per_sec": 950.0},
+          "chain_drain": {"pipelined": {"pods_per_sec": 1900.0}}}
+    assert bench.northstar_gate(ok, path=str(path)) == []
+    bad = {"gang": {"pods_per_sec": 850.0},
+           "chain_drain": {"pipelined": {"pods_per_sec": 1500.0}}}
+    failures = bench.northstar_gate(bad, path=str(path))
+    assert len(failures) == 2
+    assert any("gang.pods_per_sec" in f for f in failures)
+    # metrics missing on either side are skipped, not failed
+    assert bench.northstar_gate({}, path=str(path)) == []
+    assert bench.northstar_gate(ok, path=str(tmp_path / "missing.json")) == []
+
+
+def test_gate_entries_derive_floor_from_spread():
+    import bench
+    detail = {
+        "gang": {"pods_per_sec": 1694.5,
+                 "spread": {"min_s": 2.417, "median_s": 2.609}},
+        "chain_drain": {
+            "pipelined": {"pods_per_sec": 2195.0,
+                          "spread": {"min_s": 1.866, "median_s": 1.9}},
+            "chain_on": {"pods_per_sec": 1753.9, "spread": {}},
+        },
+    }
+    gate = bench.gate_entries(detail)
+    assert set(gate) == {"gang.pods_per_sec",
+                         "chain_drain.pipelined.pods_per_sec",
+                         "chain_drain.chain_on.pods_per_sec"}
+    for ref in gate.values():
+        assert 0.7 <= ref["min_frac"] < 1.0
+    # a run matching its own recording passes its own gate
+    import json as _json
+    assert bench.northstar_gate(detail, path="/nonexistent") == []

@@ -907,6 +907,67 @@ def test_cli_clean_exit_zero(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# delta family: incremental-tensorization discipline
+
+
+DELTA_BAD = """
+import jax
+from kubetpu.state.tensors import SnapshotBuilder
+
+
+class MiniScheduler:
+    def schedule_pending(self):
+        return self._prepare()
+
+    def _prepare(self):
+        builder = SnapshotBuilder()
+        host = builder.build([])
+        cluster = host.to_device()
+        return jax.device_put(cluster)
+"""
+
+DELTA_GOOD = """
+from kubetpu.state.tensors import SnapshotBuilder
+
+
+class MiniScheduler:
+    def schedule_pending(self):
+        return self._prepare()
+
+    def _prepare(self):
+        # the delta path: no rebuild, no upload
+        cluster, stats = self._delta.refresh([])
+        return cluster
+
+    def resync(self):
+        # the blessed resync path may rebuild the world
+        builder = SnapshotBuilder()
+        return builder.build([]).to_device()
+
+    def prewarm(self):
+        # NOT reachable from schedule_pending: out-of-cycle builds are fine
+        return SnapshotBuilder().build([]).to_device()
+"""
+
+
+def test_delta_fires_on_cycle_loop_retensorize(tmp_path):
+    res = lint_snippet(tmp_path, DELTA_BAD, rules=["delta"])
+    assert rule_ids(res) == ["delta/full-retensorize-in-loop"]
+    # all three shapes fire: .build(), .to_device(), device_put
+    assert len(res.findings) == 3
+
+
+def test_delta_quiet_on_blessed_resync_and_out_of_cycle(tmp_path):
+    res = lint_snippet(tmp_path, DELTA_GOOD, rules=["delta"])
+    assert res.clean, [str(f) for f in res.findings]
+
+
+def test_delta_family_registered():
+    from tools.kubelint import RULE_FAMILIES
+    assert "delta" in RULE_FAMILIES
+
+
+# ---------------------------------------------------------------------------
 # the real gate: the shipped tree is clean
 
 

@@ -1,11 +1,13 @@
 #!/bin/sh
 # CI lint gate: kubelint in JSON mode, nonzero exit on any unsuppressed
-# finding.  Covers all five rule families — host-sync, recompile, numeric,
-# purity, and concurrency (lock discipline for the threaded host path,
+# finding.  Covers all six rule families — host-sync, recompile, numeric,
+# purity, concurrency (lock discipline for the threaded host path,
 # including the flight-recorder classes: utils/trace.py FlightRecorder /
 # CycleRecord and utils/decisions.py DecisionLog are guarded-by annotated
-# and must stay tree-clean).  Builders run this by default via
-# `make lint`; the same check gates tier-1 through
+# and must stay tree-clean), and delta (incremental-tensorization
+# discipline: no full re-tensorize/device_put reachable from the cycle
+# loop outside the blessed DeltaTensorizer resync path).  Builders run
+# this by default via `make lint`; the same check gates tier-1 through
 # tests/test_kubelint.py::test_kubetpu_tree_is_clean.
 set -e
 cd "$(dirname "$0")/.."
@@ -15,3 +17,7 @@ python -m tools.kubelint kubetpu/ --json
 # future refactor can't hide a violation behind an unrelated suppression
 python -m tools.kubelint kubetpu/utils/trace.py kubetpu/utils/decisions.py \
 	--rules concurrency --json
+# explicit delta-family pass over the serving loop: the cycle path must
+# stay scatter-only (full-retensorize-in-loop), independent of any
+# unrelated suppression elsewhere in the tree
+python -m tools.kubelint kubetpu/scheduler.py --rules delta --json

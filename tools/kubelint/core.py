@@ -12,6 +12,7 @@ so kubelint checks them mechanically.  One module per rule family:
     rules_numeric      numeric-fidelity rules             (numeric/*)
     rules_purity       kernel-purity rules                (purity/*)
     rules_concurrency  host-path lock-discipline rules    (concurrency/*)
+    rules_delta        incremental-tensorization rules    (delta/*)
 
 Inline suppression syntax (reason is REQUIRED):
 
@@ -198,8 +199,8 @@ def run_lint(paths: Sequence[str], root: str = ".",
     """Lint every .py file under ``paths``.  ``rules``: optional rule-id
     prefixes to restrict to (e.g. ["host-sync"])."""
     from . import callgraph as cg
-    from . import (rules_concurrency, rules_host_sync, rules_numeric,
-                   rules_purity, rules_recompile)
+    from . import (rules_concurrency, rules_delta, rules_host_sync,
+                   rules_numeric, rules_purity, rules_recompile)
 
     modules = load_modules(paths, root=root)
     ctx = LintContext(modules)
@@ -209,7 +210,7 @@ def run_lint(paths: Sequence[str], root: str = ".",
     for mod in modules:
         raw.extend(mod.bad_suppressions)
         for rule_mod in (rules_host_sync, rules_recompile, rules_numeric,
-                         rules_purity, rules_concurrency):
+                         rules_purity, rules_concurrency, rules_delta):
             raw.extend(rule_mod.check(mod, ctx))
 
     if rules:

@@ -1,0 +1,141 @@
+"""delta/* — incremental-tensorization discipline.
+
+The serving contract after the delta-tensorization PR (state/delta.py):
+cluster tensors are DEVICE RESIDENTS updated by bounded scatters
+(``programs.apply_cluster_delta``); the full ``SnapshotBuilder.build()``
+walk + whole-cluster ``to_device``/``device_put`` upload is the blessed
+anti-entropy RESYNC path owned by ``DeltaTensorizer`` — never something a
+scheduling cycle does ad hoc.  The flight recorder proved that one full
+re-tensorize per cycle is exactly the host-share regression this rule
+exists to keep out.
+
+Rule:
+
+  delta/full-retensorize-in-loop
+      a ``SnapshotBuilder(...).build(...)`` call, a ``.to_device()``
+      call, or a ``jax.device_put`` of cluster state inside a method
+      reachable from the scheduler's cycle loop (the ``self.*`` call
+      closure of ``schedule_pending`` on any class that defines it),
+      outside the blessed resync path (``DeltaTensorizer._resync`` /
+      methods named ``resync``/``_resync``).  Route the rebuild through
+      ``DeltaTensorizer.refresh`` instead — it falls back to a full
+      build only on its counted resync triggers.
+
+Out-of-cycle call sites (``prewarm``, tools, benches) are not reachable
+from ``schedule_pending`` and are untouched.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from .core import Finding, SourceModule
+
+RULE = "delta/full-retensorize-in-loop"
+
+# the cycle-loop entry point: any class defining this method is treated
+# as a scheduler, and its self-call closure as the per-cycle hot path
+CYCLE_ROOT = "schedule_pending"
+
+# methods allowed to rebuild/upload: the blessed anti-entropy resync
+BLESSED = {"resync", "_resync"}
+
+
+def _methods_of(cls: ast.ClassDef) -> Dict[str, ast.FunctionDef]:
+    return {stmt.name: stmt for stmt in cls.body
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _self_calls(fn: ast.AST) -> Set[str]:
+    """Names of self.<method>(...) calls anywhere in a method body."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"):
+            out.add(node.func.attr)
+    return out
+
+
+def _snapshot_builder_names(fn: ast.AST, cg, mi) -> Set[str]:
+    """Local names assigned from a SnapshotBuilder(...) construction."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign) or not isinstance(node.value,
+                                                              ast.Call):
+            continue
+        dotted = cg.resolve_dotted(mi, node.value.func)
+        if dotted is not None and dotted.split(".")[-1] == "SnapshotBuilder":
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _check_method(module: SourceModule, cg, mi, name: str,
+                  fn: ast.AST, out: List[Finding]) -> None:
+    builders = _snapshot_builder_names(fn, cg, mi)
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            recv = func.value
+            if func.attr == "build":
+                direct = (isinstance(recv, ast.Call)
+                          and (cg.resolve_dotted(mi, recv.func) or ""
+                               ).split(".")[-1] == "SnapshotBuilder")
+                via_name = isinstance(recv, ast.Name) and recv.id in builders
+                if direct or via_name:
+                    out.append(Finding(
+                        RULE, module.path, node.lineno, node.col_offset + 1,
+                        "full SnapshotBuilder.build() walk reachable from "
+                        "the cycle loop (via %s) — route it through "
+                        "DeltaTensorizer.refresh; only the blessed resync "
+                        "path may rebuild the world" % name))
+                continue
+            if func.attr == "to_device":
+                out.append(Finding(
+                    RULE, module.path, node.lineno, node.col_offset + 1,
+                    "whole-cluster to_device() upload reachable from the "
+                    "cycle loop (via %s) — the cluster is a device "
+                    "resident updated by apply_cluster_delta scatters; "
+                    "only the blessed resync path re-uploads" % name))
+                continue
+        dotted = cg.resolve_dotted(mi, func)
+        if dotted is not None and (dotted == "jax.device_put"
+                                   or dotted.endswith(".device_put")):
+            out.append(Finding(
+                RULE, module.path, node.lineno, node.col_offset + 1,
+                "device_put inside the cycle loop (via %s) — per-cycle "
+                "host->device uploads of cluster state defeat the "
+                "delta pipeline; ship a ClusterDelta instead" % name))
+
+
+def check(module: SourceModule, ctx) -> List[Finding]:
+    cg = ctx.callgraph
+    mi = cg.module_info(module)
+    out: List[Finding] = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        methods = _methods_of(node)
+        if CYCLE_ROOT not in methods:
+            continue
+        reachable: Set[str] = set()
+        frontier = [CYCLE_ROOT]
+        while frontier:
+            m = frontier.pop()
+            if m in reachable:
+                continue
+            reachable.add(m)
+            for callee in _self_calls(methods[m]):
+                if callee in methods and callee not in reachable:
+                    frontier.append(callee)
+        for name in sorted(reachable):
+            if name in BLESSED:
+                continue
+            _check_method(module, cg, mi, name, methods[name], out)
+    return out

@@ -94,7 +94,29 @@ def flame_summary(spans: List[dict]) -> str:
             f"{stage[:44]:<44} {len(ss):>5} {total:>8.3f} "
             f"{1000 * total / len(ss):>8.1f} {dev:>10.3f}  "
             f"{_bar(total / window)} {100 * total / window:5.1f}%")
+    delta = delta_summary(spans)
+    if delta:
+        lines += ["", delta]
     return "\n".join(lines)
+
+
+def delta_summary(spans: List[dict]) -> str:
+    """One-line incremental-tensorization digest under the stage table:
+    how many cycles rode the scatter path (and their p50 updated-row
+    count) vs how many fell back to the blessed full resync.  Counted
+    from the delta-apply / resync spans so the split matches the
+    scheduler's own counters (a pod-axis-growth cycle emits a
+    delta-build AND a resync span but applies no scatter — it counts as
+    a resync here, exactly like Scheduler.resync_count)."""
+    counts = sorted(s["args"]["delta_rows"] for s in spans
+                    if s["stage"] == "delta-apply"
+                    and "delta_rows" in s.get("args", {}))
+    resyncs = sum(1 for s in spans if s["stage"] == "resync")
+    if not counts and not resyncs:
+        return ""
+    p50 = counts[len(counts) // 2] if counts else 0
+    return (f"delta-tensorize: {len(counts)} delta cycles "
+            f"(rows p50 {p50}), {resyncs} resyncs")
 
 
 def cycle_tree(spans: List[dict], cycle: int,
