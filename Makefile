@@ -3,7 +3,7 @@
 # lint gate via tests/test_kubelint.py).  `make help` lists everything.
 
 .PHONY: help lint lock-graph test sanitize-test race-test flight-test \
-	delta-test trace bench
+	delta-test census census-test trace bench
 
 help:
 	@echo "kubetpu targets:"
@@ -24,6 +24,12 @@ help:
 	@echo "  make delta-test     incremental tensorization suite (delta-vs-"
 	@echo "                      rebuild golden equivalence, resync fallbacks,"
 	@echo "                      scatter compile-once watchdog, bench gate)"
+	@echo "  make census         regenerate COMPILE_MANIFEST.json from the"
+	@echo "                      compile-surface census (tools/kubecensus);"
+	@echo "                      run after an INTENTIONAL surface change"
+	@echo "  make census-test    census suite: every jaxpr rule fires on a"
+	@echo "                      bad snippet, manifest idempotence, drift"
+	@echo "                      gate, runtime compile-event matching"
 	@echo "  make trace          run the pipelined drain with the flight"
 	@echo "                      recorder armed, write PIPELINE_TRACE.json +"
 	@echo "                      .perfetto.json, print the text flame summary"
@@ -63,6 +69,16 @@ flight-test:
 delta-test:
 	JAX_PLATFORMS=cpu python -m pytest \
 		tests/test_delta.py -q -p no:cacheprovider
+
+# compile-surface census: trace every registered jit root across the
+# pow2 ladder and rewrite COMPILE_MANIFEST.json (byte-identical when the
+# surface is unchanged); `make lint` / ci_lint.sh fail on drift
+census:
+	JAX_PLATFORMS=cpu python -m tools.kubecensus --write
+
+census-test:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_kubecensus.py -q -p no:cacheprovider
 
 # pipelined-drain trace via the flight recorder + text flame summary
 # (PIPELINE_TRACE.json + PIPELINE_TRACE.perfetto.json for ui.perfetto.dev)

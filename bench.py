@@ -653,6 +653,15 @@ def main() -> None:
 
     from kubetpu.utils.compilation import enable_persistent_cache
     enable_persistent_cache()
+    # BENCH_GATE=1: observe every XLA compile event for the census
+    # cross-check (runtime-compile-events ⊆ COMPILE_MANIFEST.json) —
+    # watchdog only, none of the sanitizer's numeric flags, so the
+    # measured numbers are undisturbed.  Installed BEFORE jax first
+    # dispatches so no compile escapes the log.
+    census_wd = None
+    if os.environ.get("BENCH_GATE", "0") == "1":
+        from kubetpu.utils.sanitize import install_compile_watchdog
+        census_wd = install_compile_watchdog()
     import jax
 
     # the flight recorder rides every bench cycle (its < 2% overhead is
@@ -785,6 +794,25 @@ def main() -> None:
     # AFTER the artifacts are written so a failing run is still inspectable.
     if os.environ.get("BENCH_GATE", "0") == "1":
         failures = northstar_gate(detail)
+        # census cross-check: every compile event the watchdog observed
+        # for a REGISTERED kernel program must be a COMPILE_MANIFEST.json
+        # row (exact at census rungs, structural — same program/arity/
+        # dtypes/ranks — at serving shapes).  An "outside" event means the
+        # observed compile surface drifted from the committed census.
+        if census_wd is not None:
+            try:
+                from tools.kubecensus.manifest import (load_manifest,
+                                                       match_compile_events)
+                rows = load_manifest()
+                if rows:
+                    rep = match_compile_events(census_wd.counts, rows)
+                    print(json.dumps({"census_check": rep}),
+                          file=sys.stderr)
+                    for ev in rep["outside"]:
+                        failures.append("compile event outside "
+                                        "COMPILE_MANIFEST.json: " + ev)
+            except ImportError:
+                pass   # bench run outside the repo tree
         if failures:
             print(json.dumps({"bench_gate": "FAIL",
                               "regressions": failures}), file=sys.stderr)

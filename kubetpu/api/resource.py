@@ -13,6 +13,7 @@ requested + used`` is bit-exact on TPU.
 
 from __future__ import annotations
 
+import functools
 import re
 from typing import Dict, Union
 
@@ -26,9 +27,17 @@ _QTY_RE = re.compile(r"^([+-]?[0-9.]+)([numkMGTPEi]{0,2})$")
 
 def parse_quantity(s: Union[str, int, float]) -> float:
     """Parse a Kubernetes quantity string ("100m", "32Gi", "4") to a float
-    in base units (cores, bytes, counts)."""
+    in base units (cores, bytes, counts).  String parses are memoized: a
+    cluster's quantity vocabulary is tiny, and hot host paths (the PVC
+    matchable-PV scan probes every (PV, requirement-signature) pair per
+    overlay build) re-parse the same strings every cycle."""
     if isinstance(s, (int, float)):
         return float(s)
+    return _parse_quantity_str(s)
+
+
+@functools.lru_cache(maxsize=4096)
+def _parse_quantity_str(s: str) -> float:
     s = s.strip()
     m = _QTY_RE.match(s)
     if not m:

@@ -401,6 +401,13 @@ class PersistentVolumeClaim:
     volume_name: str = ""          # bound PV name ("" => unbound)
     storage_class_name: str = ""
     phase: str = "Pending"
+    # matching requirements an unbound claim imposes on candidate PVs
+    # (reference: pv_controller findMatchingVolume): requested storage
+    # under resources.requests["storage"], and the claim's access modes —
+    # a PV must offer a SUPERSET.  Empty = unconstrained (back-compat).
+    access_modes: List[str] = field(default_factory=list)
+    resources: ResourceRequirements = field(
+        default_factory=ResourceRequirements)
     kind: str = "PersistentVolumeClaim"
 
 
@@ -408,6 +415,7 @@ class PersistentVolumeClaim:
 class PersistentVolume:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     capacity: Dict[str, Any] = field(default_factory=dict)
+    access_modes: List[str] = field(default_factory=list)
     node_affinity: Optional[NodeSelector] = None
     storage_class_name: str = ""
     # volume source (scheduler-relevant subset, for NodeVolumeLimits)

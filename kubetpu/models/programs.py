@@ -19,6 +19,15 @@ import jax.numpy as jnp
 
 from ..ops import kernels as K
 
+# Sharded and single-device dispatch must pick IDENTICAL placements: the
+# selectHost tie-break samples random bits, and under the legacy
+# (non-partitionable) threefry lowering those bits change when the logits
+# are sharded over a mesh — silently breaking the serial-replay oracle for
+# multi-chip runs.  Partitionable threefry makes the bits a pure function
+# of key + position at every sharding (newer jax defaults to exactly this;
+# pinning it here keeps placements stable across jax versions too).
+jax.config.update("jax_threefry_partitionable", True)
+
 # Default plugin weights (reference: algorithmprovider/registry.go:119-134).
 DEFAULT_SCORE_PLUGINS: Tuple[Tuple[str, int], ...] = (
     ("NodeResourcesBalancedAllocation", 1),

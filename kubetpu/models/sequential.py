@@ -117,7 +117,10 @@ def _schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
     # nodes in rotated processing order, then advances the start index by
     # the number of nodes examined (generic_scheduler.go:379-399,451,487)
     sample = cfg.percentage_of_nodes_to_score < 100
-    n_valid = jnp.sum(cluster.node_valid.astype(jnp.int32))
+    # dtype pinned: integer jnp.sum promotes to the DEFAULT int, which is
+    # i64 wherever x64 is enabled — and n_valid feeds the i32 'start'
+    # scan carry (census/f64-promotion)
+    n_valid = jnp.sum(cluster.node_valid, dtype=jnp.int32)
     sample_limit = _num_feasible_nodes_to_find(
         n_valid, cfg.percentage_of_nodes_to_score)
 
@@ -403,7 +406,10 @@ def _schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
             allowed_perm = feas_perm & (cum <= sample_limit)
             total_feas = cum[-1]
             reached = cum >= sample_limit
-            kth_pos = jnp.argmax(reached)
+            # argmax returns the DEFAULT int dtype, which widens to i64
+            # wherever x64 is enabled and breaks the i32 'start' carry
+            # (census/f64-promotion); pin the index dtype
+            kth_pos = jnp.argmax(reached).astype(jnp.int32)
             n_processed = jnp.where(total_feas >= sample_limit,
                                     kth_pos + 1, n_valid)
             feas = jnp.zeros((N,), bool).at[perm].max(allowed_perm)

@@ -521,8 +521,11 @@ def interpod_filter(cluster, batch,
     pod_keyed = (jnp.take(pod_tp.T, jnp.clip(keys_r, 0, None), axis=0) >= 0) \
         & (keys_r >= 0)[:, None] \
         & (cluster.pod_node >= 0)[None, :] & cluster.pod_valid[None, :]
-    tot = jnp.sum(jnp.where(pod_keyed & contrib
-                            & ra.valid.reshape(-1)[:, None], 1.0, 0.0),
+    # bool -> f32 cast, not where(mask, 1.0, 0.0): two Python-float
+    # branches COMMIT to the default float dtype, so the count silently
+    # becomes f64 wherever x64 is enabled (census/f64-promotion)
+    tot = jnp.sum((pod_keyed & contrib
+                   & ra.valid.reshape(-1)[:, None]).astype(jnp.float32),
                   axis=1)  # [B*Tr]
     no_matches = jnp.sum(tot.reshape(B, Tr), axis=1) < 0.5
     self_all = jnp.all(ra.self_match | ~ra.valid, axis=1) & has_ra

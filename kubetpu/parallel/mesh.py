@@ -39,6 +39,19 @@ from ..state.tensors import ClusterTensors
 AXIS_PODS = "pods"
 AXIS_NODES = "nodes"
 
+
+def ambient_mesh(mesh: Mesh):
+    """Context manager installing ``mesh`` as the ambient mesh for the
+    enclosed dispatches.  ``jax.set_mesh`` only exists on newer jax; on
+    runtimes without it the legacy ``Mesh`` object is itself a context
+    manager with the same effect for committed-sharding dispatch (the
+    inputs carry NamedShardings either way — the ambient mesh only backs
+    mesh-less intermediates), so fall back to entering the mesh directly."""
+    setter = getattr(jax, "set_mesh", None)
+    if setter is not None:
+        return setter(mesh)
+    return mesh
+
 # ClusterTensors fields whose leading axis is the node axis N.
 NODE_AXIS_FIELDS = frozenset({
     "allocatable", "requested", "nonzero_requested", "node_valid",
@@ -145,7 +158,7 @@ def sharded_apply_cluster_delta(cluster, delta, mesh: Mesh,
     so the next dispatch's shard_cluster is a pass-through."""
     from ..models import programs
     delta = replicate(jax.tree.map(np.asarray, delta), mesh)
-    with jax.set_mesh(mesh):
+    with ambient_mesh(mesh):
         return programs.apply_cluster_delta(cluster, delta, donate=donate)
 
 
@@ -157,7 +170,7 @@ def sharded_schedule_batch(cluster, batch, cfg: programs.ProgramConfig, rng,
     cluster = shard_cluster(cluster, mesh, shard_existing_pods)
     batch = shard_batch(batch, mesh)
     rng = _put(rng, NamedSharding(mesh, P()))
-    with jax.set_mesh(mesh):
+    with ambient_mesh(mesh):
         return programs.schedule_batch(cluster, batch, cfg, rng)
 
 
@@ -167,7 +180,7 @@ def sharded_filter_and_score(cluster, batch, cfg: programs.ProgramConfig,
     """filter_and_score over the mesh (the extender path's device half)."""
     cluster = shard_cluster(cluster, mesh, shard_existing_pods)
     batch = shard_batch(batch, mesh)
-    with jax.set_mesh(mesh):
+    with ambient_mesh(mesh):
         return programs.filter_and_score(cluster, batch, cfg,
                                          host_ok=_shard_host_ok(host_ok,
                                                                 mesh))
@@ -195,7 +208,7 @@ def sharded_schedule_gang(cluster, batch, cfg: programs.ProgramConfig, rng,
     cluster = shard_cluster(cluster, mesh, shard_existing_pods)
     batch = shard_batch(batch, mesh)
     rng = _put(rng, NamedSharding(mesh, P()))
-    with jax.set_mesh(mesh):
+    with ambient_mesh(mesh):
         return gang.schedule_gang(cluster, batch, cfg, rng,
                                   host_ok=_shard_host_ok(host_ok, mesh),
                                   max_rounds=max_rounds,
@@ -216,7 +229,7 @@ def sharded_schedule_sequential(cluster, batch, cfg: programs.ProgramConfig,
     cluster = shard_cluster(cluster, mesh, shard_existing_pods)
     batch = shard_batch(batch, mesh)
     rng = _put(rng, NamedSharding(mesh, P()))
-    with jax.set_mesh(mesh):
+    with ambient_mesh(mesh):
         return sequential.schedule_sequential(
             cluster, batch, cfg, rng,
             hard_pod_affinity_weight=hard_pod_affinity_weight,

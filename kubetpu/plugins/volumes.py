@@ -32,6 +32,49 @@ ERR_REASON_MAX_VOLUME_COUNT = "node(s) exceed max volume count"
 _ZONE_KEYS = (api.LABEL_ZONE, api.LABEL_REGION, api.LABEL_ZONE_LEGACY,
               api.LABEL_REGION_LEGACY)
 
+
+def _quantity_or_none(q) -> Optional[float]:
+    """Parse a quantity, treating a malformed string as absent: one bad
+    object in the store must degrade to an unconstrained match, not
+    raise out of the per-cycle overlay build / commit-time re-check."""
+    from ..api.resource import parse_quantity
+    try:
+        return float(parse_quantity(q))
+    except ValueError:
+        return None
+
+
+def claim_storage_request(pvc: api.PersistentVolumeClaim) -> float:
+    """Requested storage bytes (0 = unconstrained)."""
+    q = pvc.resources.requests.get("storage")
+    if not q:
+        return 0.0
+    return _quantity_or_none(q) or 0.0
+
+
+def pv_satisfies_claim(pv: api.PersistentVolume,
+                       pvc: api.PersistentVolumeClaim) -> bool:
+    """Node-independent half of findMatchingVolume (reference:
+    pkg/controller/volume/persistentvolume/pv_controller checkVolumeSatisfy
+    ClaimSpec): same StorageClass, capacity >= the claim's storage
+    request, and access modes a SUPERSET of the claim's.  A PV without a
+    declared capacity is treated as unbounded and a claim without access
+    modes as unconstrained (back-compat with minimal objects).  Shared by
+    the host plugin's _find_matching_pv and the device overlay's
+    matchable-PV pre-filter (state/volumes.py) so commit-time re-checks
+    can never disagree with the device mask on this dimension."""
+    if pv.storage_class_name != pvc.storage_class_name:
+        return False
+    want = claim_storage_request(pvc)
+    if want > 0:
+        cap = pv.capacity.get("storage")
+        got = _quantity_or_none(cap) if cap is not None else None
+        if got is not None and got < want:
+            return False
+    if pvc.access_modes and not set(pvc.access_modes) <= set(pv.access_modes):
+        return False
+    return True
+
 class _VolumePlugin(fw.Plugin):
     def __init__(self, store=None):
         self.store = store
@@ -100,7 +143,7 @@ class VolumeBinding(_VolumePlugin, fw.PreFilterPlugin, fw.FilterPlugin,
         if self.store is None:
             return None
         for pv in self.store.list_pvs():
-            if (pv.storage_class_name == pvc.storage_class_name
+            if (pv_satisfies_claim(pv, pvc)
                     and _pv_matches_node(pv, node)
                     and not self.store.pv_is_bound(pv.metadata.name)):
                 return pv

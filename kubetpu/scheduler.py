@@ -419,8 +419,12 @@ class Scheduler:
                 return outcomes
             (name, group), = by_profile.items()
             fwk = self.profiles[name]
+            # ONE relevance walk for the whole cycle: the serialize
+            # decision below AND _prepare_group's host-mask gates share
+            # this map (the round-5 ADVICE double-walk finding)
+            relevance = self._host_relevance(fwk, group)
             if prev is not None and any(
-                    fwk.has_relevant_host_filters(qp.pod) for qp in group):
+                    rel for rel, _ in relevance.values()):
                 # host filter masks and the volume overlay are built from
                 # the CACHE, which excludes the uncommitted in-flight
                 # cycle's placements — preparing now could pass a node the
@@ -437,7 +441,8 @@ class Scheduler:
             # uncommitted=prev: k-1's buffers must not be donated away
             # before its commit-side device work runs
             prep, early = self._prepare_group(
-                fwk, group, uncommitted=prev[0] if prev else None)
+                fwk, group, uncommitted=prev[0] if prev else None,
+                relevance=relevance)
             if prep is None:
                 return (returned + early
                         + (self._finish_group(*prev) if prev else []))
@@ -453,7 +458,8 @@ class Scheduler:
                 returned += self._finish_group(*prev)
                 prev = None
                 stale = prep.trace
-                prep, early2 = self._prepare_group(fwk, prep.live)
+                prep, early2 = self._prepare_group(fwk, prep.live,
+                                                   relevance=relevance)
                 stale.finish(discarded=True)
                 early += early2
                 if prep is None:
@@ -480,7 +486,8 @@ class Scheduler:
                 # and re-run synchronously over the surviving pods only
                 # (already-failed pods' outcomes in `early` are final)
                 stale = prep.trace
-                prep, early2 = self._prepare_group(fwk, prep.live)
+                prep, early2 = self._prepare_group(fwk, prep.live,
+                                                   relevance=relevance)
                 stale.finish(discarded=True)
                 early += early2
                 if prep is None:
@@ -548,8 +555,32 @@ class Scheduler:
             res = self._dispatch_group(prep)
         return outcomes + self._finish_group(prep, res)
 
+    @staticmethod
+    def _host_relevance(fwk: Framework, qpods: List[QueuedPodInfo]
+                        ) -> Dict[str, Tuple[bool, bool]]:
+        """ONE walk of the host filter plugins' relevance predicates per
+        pod: uid -> (any relevant, any relevant beyond the device-covered
+        volume family).  The walk is measurable at 4k pods/cycle, so every
+        consumer — the pipelined drain's serialize decision, the host-mask
+        loop gate, and the commit-time re-check — shares this map instead
+        of re-walking (the round-5 ADVICE double-walk finding)."""
+        from .state.volumes import DEVICE_COVERED_PLUGINS
+        out: Dict[str, Tuple[bool, bool]] = {}
+        for qp in qpods:
+            rel = unc = False
+            for p in fwk.host_filter_plugins:
+                if fwk._relevant(p, qp.pod):
+                    rel = True
+                    if p.name() not in DEVICE_COVERED_PLUGINS:
+                        unc = True
+                        break
+            out[qp.pod.uid] = (rel, unc)
+        return out
+
     def _prepare_group(self, fwk: Framework, qpods: List[QueuedPodInfo],
-                       uncommitted: Optional[PreparedCycle] = None):
+                       uncommitted: Optional[PreparedCycle] = None,
+                       relevance: Optional[Dict[str, Tuple[bool, bool]]]
+                       = None):
         """Host half of a cycle, up to (but excluding) the device dispatch:
         snapshot, PreFilter, tensorize-or-chain, host filter masks,
         nominated overlay.  Returns (PreparedCycle | None, early outcomes).
@@ -691,26 +722,20 @@ class Scheduler:
         N = cluster.allocatable.shape[0]
 
         # ---- host filter plugins -> mask fed into the device program.
-        # Relevance is computed ONCE per pod per cycle and reused by the
-        # commit-time re-check (it walks every host plugin's relevance
-        # predicate — measurable at 4k pods/cycle).
-        # ONE walk of the host plugins' relevance predicates per pod
-        # computes BOTH "any relevant" (the commit-time re-check gate) and
-        # "any relevant beyond the device-covered volume family" (the
-        # per-node Python loop gate) — the walk is measurable at 4k
-        # pods/cycle, so it must not run twice
+        # ONE walk of the host plugins' relevance predicates per pod per
+        # CYCLE (_host_relevance) computes BOTH "any relevant" (the
+        # commit-time re-check gate) and "any relevant beyond the
+        # device-covered volume family" (the per-node Python loop gate).
+        # The pipelined drain walks it up front for its serialize
+        # decision and passes the map in, so the walk never runs twice.
         from .state.volumes import (DEVICE_COVERED_PLUGINS,
                                     build_volume_overlay, volume_mask)
+        if relevance is None:
+            relevance = self._host_relevance(fwk, live)
         host_relevant: Dict[str, bool] = {}
         host_uncovered: Dict[str, bool] = {}
         for qp in live:
-            rel = unc = False
-            for p in fwk.host_filter_plugins:
-                if fwk._relevant(p, qp.pod):
-                    rel = True
-                    if p.name() not in DEVICE_COVERED_PLUGINS:
-                        unc = True
-                        break
+            rel, unc = relevance[qp.pod.uid]
             host_relevant[qp.pod.uid] = rel
             host_uncovered[qp.pod.uid] = unc
         # the volume family evaluates ON DEVICE (state/volumes.py): one

@@ -14,8 +14,17 @@ guarantees.
 Semantics covered (reference files per plugin docstrings):
 - VolumeBinding.filter: bound PVC -> PV node-affinity match
   (volumebinding/volume_binding.go FindPodVolumes); unbound PVC ->
-  matchable unbound PV of the same StorageClass on the node, or a
-  WaitForFirstConsumer class (provisionable).
+  matchable unbound PV on the node, or a WaitForFirstConsumer class
+  (provisionable).  "Matchable" pre-filters by the claim's FULL
+  requirement signature at overlay-build time — StorageClass, storage
+  request vs PV capacity, access-mode superset (pv_satisfies_claim, the
+  host plugin's own matcher) — host-side per distinct (class, size,
+  modes) triple, so the device verdict agrees with the commit-time host
+  re-check and PVC-heavy pipelined drains stop discarding speculative
+  chains on capacity/mode mismatches.  Known deviation: claim label
+  SELECTORS (spec.selector) are not matched (neither here nor in the
+  host plugin), and immediate-binding unbound claims are still judged
+  per node rather than failing the pod outright.
 - VolumeZone: a node with NO zone/region labels passes; otherwise every
   bound PV's zone-ish label value set must contain the node's value
   (volumezone/volume_zone.go:80).
@@ -225,7 +234,10 @@ def build_volume_overlay(store, node_infos, pods: List[api.Pod], table,
     # ---- VolumeBinding: bound PVs + unbound availability per class
     pv_rows: Dict[str, int] = {}
     pv_objs: List[api.PersistentVolume] = []
-    sc_rows: Dict[str, int] = {}
+    # claim-requirement rows: (class, storage request, access modes) ->
+    # row, with an exemplar claim per row for the PV-side matcher
+    sc_rows: Dict[Tuple, int] = {}
+    sc_claims: List[api.PersistentVolumeClaim] = []
 
     def pv_row(pv) -> int:
         r = pv_rows.get(pv.metadata.name)
@@ -287,10 +299,23 @@ def build_volume_overlay(store, node_infos, pods: List[api.Pod], table,
                         # — on nodes with zone labels
                         pod_zone_err[i] = True
                     if binding is not None and not wffc:
-                        # matchable-PV check; "" is a real class key (a
-                        # classless PVC matches classless PVs)
-                        scs.append(sc_rows.setdefault(sc_name or "",
-                                                      len(sc_rows)))
+                        # matchable-PV check, keyed by the claim's FULL
+                        # requirement signature — class ("" is a real key:
+                        # a classless PVC matches classless PVs), storage
+                        # request, access modes — so capacity/access-mode
+                        # pre-filtering happens host-side at overlay-build
+                        # time and the device mask agrees with the host
+                        # plugin's commit-time verdict (a permissive mask
+                        # here costs a speculative-chain discard per
+                        # commit failure in pipelined mode)
+                        sig = (sc_name or "",
+                               vplug.claim_storage_request(pvc),
+                               frozenset(pvc.access_modes))
+                        r = sc_rows.get(sig)
+                        if r is None:
+                            r = sc_rows[sig] = len(sc_rows)
+                            sc_claims.append(pvc)
+                        scs.append(r)
         pod_bound.append(bound)
         pod_scs.append(scs)
         if zreq:
@@ -306,14 +331,20 @@ def build_volume_overlay(store, node_infos, pods: List[api.Pod], table,
         else:
             zone_reqs.append(None)
 
-    # unbound PVs per referenced StorageClass (for the matchable check):
-    # ONE scan registers rows and remembers (sc, pv) pairs for sc_pv_hot
+    # unbound PVs per claim-requirement row (for the matchable check):
+    # ONE scan over the PV list probes every registered requirement
+    # signature — rows are few (distinct (class, size, modes) triples in
+    # the batch), and pv_satisfies_claim is the host plugin's own
+    # matcher, so the device verdict can never be more permissive than
+    # the commit-time re-check on this dimension
     sc_pv_pairs: List[Tuple[int, int]] = []
     if binding is not None and sc_rows:
         for pv in store.list_pvs():
-            r = sc_rows.get(pv.storage_class_name)
-            if r is not None and not store.pv_is_bound(pv.metadata.name):
-                sc_pv_pairs.append((r, pv_row(pv)))
+            if store.pv_is_bound(pv.metadata.name):
+                continue
+            for sig, r in sc_rows.items():
+                if vplug.pv_satisfies_claim(pv, sc_claims[r]):
+                    sc_pv_pairs.append((r, pv_row(pv)))
 
     PVu = pow2_bucket(len(pv_objs), 8)
     # flatten PV nodeAffinity terms (OR-of-terms, like required node

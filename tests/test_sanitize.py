@@ -241,3 +241,42 @@ def test_maybe_enable_from_env_off_by_default(monkeypatch):
     monkeypatch.delenv(sanitize.ENV_FLAG, raising=False)
     assert sanitize.maybe_enable_from_env() is None
     assert sanitize.current_watchdog() is None
+
+
+def test_watchdog_uninstall_restores_and_respects_active_sanitizer():
+    """The pxla-logger arming is refcounted across the standalone compile
+    watchdog and the full sanitizer: uninstalling the bench watchdog
+    while the sanitizer is active must leave the logger armed (DEBUG,
+    records flowing to the sanitizer's watchdog), and the ORIGINAL
+    level/propagate come back only when the last handler detaches."""
+    logger = logging.getLogger(sanitize._PXLA_LOGGER)
+    prev_level, prev_prop = logger.level, logger.propagate
+    logger.setLevel(logging.WARNING)
+    logger.propagate = True
+    try:
+        wd = sanitize.install_compile_watchdog()
+        assert logger.level == logging.DEBUG
+        swd = sanitize.enable_sanitizer()
+        assert swd is not wd
+        sanitize.uninstall_compile_watchdog(wd)
+        # sanitizer still armed: logger must stay open for ITS watchdog
+        assert logger.level == logging.DEBUG
+        logger.handle(logging.LogRecord(
+            sanitize._PXLA_LOGGER, logging.DEBUG, __file__, 1,
+            "Compiling prog with global shapes and types "
+            "[ShapedArray(float32[8,4])]. Argument mapping: (x,).",
+            (), None))
+        assert swd.compile_count() == 1
+        sanitize.disable_sanitizer()
+        # last handler gone: the ORIGINAL state (not a stale snapshot)
+        assert logger.level == logging.WARNING
+        assert logger.propagate is True
+        # plain install/uninstall pair restores too
+        wd2 = sanitize.install_compile_watchdog()
+        assert logger.level == logging.DEBUG
+        sanitize.uninstall_compile_watchdog(wd2)
+        assert logger.level == logging.WARNING
+    finally:
+        sanitize.disable_sanitizer()
+        logger.setLevel(prev_level)
+        logger.propagate = prev_prop

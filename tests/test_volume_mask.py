@@ -70,6 +70,10 @@ def build_world(seed):
         pv = api.PersistentVolume(
             metadata=api.ObjectMeta(name=f"pv{i}", labels=labels),
             node_affinity=aff,
+            capacity=({"storage": rng.choice(["1Gi", "5Gi", "20Gi"])}
+                      if rng.random() < 0.7 else {}),
+            access_modes=rng.choice([[], ["ReadWriteOnce"],
+                                     ["ReadWriteOnce", "ReadWriteMany"]]),
             storage_class_name=rng.choice(["fast", "", "wait"]),
             aws_elastic_block_store=(f"ebs-{i}" if rng.random() < 0.4
                                      else None),
@@ -99,9 +103,17 @@ def build_world(seed):
                         metadata=api.ObjectMeta(name=claim),
                         volume_name=rng.choice(pv_names))
                 else:
+                    # capacity / access-mode requirements exercise the
+                    # matchable-PV pre-filter (pv_satisfies_claim)
                     pvc = api.PersistentVolumeClaim(
                         metadata=api.ObjectMeta(name=claim),
-                        storage_class_name=rng.choice(["fast", "wait", ""]))
+                        storage_class_name=rng.choice(["fast", "wait", ""]),
+                        access_modes=rng.choice([[], ["ReadWriteOnce"],
+                                                 ["ReadWriteMany"]]),
+                        resources=api.ResourceRequirements(
+                            requests=({"storage": rng.choice(
+                                ["512Mi", "2Gi", "10Gi"])}
+                                if rng.random() < 0.7 else {})))
                 store.add(pvc)
                 vols.append(api.Volume(name=f"v{j}",
                                        persistent_volume_claim=claim))
@@ -194,3 +206,94 @@ def test_volume_mask_multi_pv_zone_intersection():
     want = host_verdicts(store, infos, [pod])[0]
     np.testing.assert_array_equal(got, want)
     assert not want.any()
+
+
+def test_unbound_claim_capacity_and_modes_prefilter():
+    """The matchable-PV check is keyed by the claim's full requirement
+    signature: an unbound claim bigger than every unbound PV of its class
+    (or demanding access modes none offers) fails at the DEVICE mask, not
+    first at commit time."""
+    store = ClusterStore()
+    store.add(api.StorageClass(metadata=api.ObjectMeta(name="fast"),
+                               provisioner="kubernetes.io/aws-ebs"))
+    store.add(api.PersistentVolume(
+        metadata=api.ObjectMeta(name="small"),
+        capacity={"storage": "1Gi"}, access_modes=["ReadWriteOnce"],
+        storage_class_name="fast"))
+    infos = [NodeInfo(mknode(name="n0")), NodeInfo(mknode(name="n1"))]
+
+    def claim_pod(name, request, modes):
+        store.add(api.PersistentVolumeClaim(
+            metadata=api.ObjectMeta(name=f"{name}-c"),
+            storage_class_name="fast", access_modes=modes,
+            resources=api.ResourceRequirements(
+                requests={"storage": request})))
+        p = mkpod(name=name)
+        p.spec.volumes = [api.Volume(name="v",
+                                     persistent_volume_claim=f"{name}-c")]
+        return p
+
+    pending = [claim_pod("fits", "512Mi", []),          # 512Mi <= 1Gi
+               claim_pod("too-big", "10Gi", []),        # no PV big enough
+               claim_pod("bad-mode", "512Mi", ["ReadWriteMany"])]
+    sb = SnapshotBuilder()
+    sb.intern_pending([PodInfo(p) for p in pending])
+    cluster = sb.build(infos).to_device()
+    overlay = build_volume_overlay(store, infos, pending, sb.table, ENABLED)
+    got = np.asarray(volume_mask(cluster, overlay))
+    assert got[0].all(), "satisfiable claim must pass everywhere"
+    assert not got[1, :2].any(), "oversized claim must fail every node"
+    assert not got[2, :2].any(), "unsatisfiable access mode must fail"
+    # and the device verdict agrees with the host plugin (commit re-check)
+    want = host_verdicts(store, infos, pending)
+    assert (got[:3, :2] == want).all()
+
+
+def test_pipelined_chain_survives_unsatisfiable_claim():
+    """The chain-preserving case (round-5 ADVICE): an unbound claim no PV
+    can satisfy must fail PRE-DISPATCH via the device mask, not at the
+    commit-time host re-check — a commit failure there discards the
+    speculative chain and re-runs the cycle, gutting the pipeline win for
+    PVC-heavy batches."""
+    from kubetpu.apis.config import (KubeSchedulerConfiguration,
+                                     KubeSchedulerProfile)
+    from kubetpu.scheduler import Scheduler
+
+    store = ClusterStore()
+    for i in range(4):
+        store.add(mknode(name=f"n{i}"))
+    store.add(api.StorageClass(metadata=api.ObjectMeta(name="fast"),
+                               provisioner="kubernetes.io/aws-ebs"))
+    store.add(api.PersistentVolume(
+        metadata=api.ObjectMeta(name="pv-small"),
+        capacity={"storage": "1Gi"}, storage_class_name="fast"))
+    sched = Scheduler(store, config=KubeSchedulerConfiguration(
+        profiles=[KubeSchedulerProfile()], batch_size=8, mode="gang",
+        chain_cycles=True, pipeline_cycles=True), async_binding=False)
+
+    def wave(tag, request):
+        store.add(api.PersistentVolumeClaim(
+            metadata=api.ObjectMeta(name=f"{tag}-c"),
+            storage_class_name="fast",
+            resources=api.ResourceRequirements(
+                requests={"storage": request})))
+        p = mkpod(name=tag)
+        p.spec.volumes = [api.Volume(name="v",
+                                     persistent_volume_claim=f"{tag}-c")]
+        store.add(p)
+
+    outcomes = []
+    wave("ok-0", "512Mi")
+    wave("big-0", "10Gi")   # no matchable PV: must fail pre-dispatch
+    for _ in range(6):
+        got = sched.schedule_pending(timeout=0.0)
+        if not got:
+            break
+        outcomes.extend(got)
+    by_name = {o.pod.metadata.name: o.node for o in outcomes}
+    assert by_name.get("ok-0"), "satisfiable pod must schedule"
+    assert not by_name.get("big-0"), "oversized claim must not schedule"
+    # the point of the tightening: no commit-time failure ever discarded
+    # the speculative chain
+    assert not sched._last_commit_failed
+    sched.close()

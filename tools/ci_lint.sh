@@ -21,3 +21,12 @@ python -m tools.kubelint kubetpu/utils/trace.py kubetpu/utils/decisions.py \
 # stay scatter-only (full-retensorize-in-loop), independent of any
 # unrelated suppression elsewhere in the tree
 python -m tools.kubelint kubetpu/scheduler.py --rules delta --json
+# compile-surface census (tools/kubecensus): jaxpr-level abstract
+# interpretation of every jit root.  Fails on (a) any unsuppressed
+# census finding — donation-unconsumed, f64-promotion, host-callback,
+# rank-promotion, constant-capture, unregistered-root — and (b) DRIFT
+# against the committed COMPILE_MANIFEST.json in either direction: a
+# traced variant the manifest lacks, or a committed row no trace
+# reproduces (a dead ladder bucket).  Regenerate after an intentional
+# surface change: make census (python -m tools.kubecensus --write).
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m tools.kubecensus --check --json

@@ -1,0 +1,82 @@
+"""CLI: ``python -m tools.kubecensus [--write | --check] [--json]``.
+
+--write      regenerate COMPILE_MANIFEST.json from a fresh census
+--check      (default) regenerate in memory, diff against the committed
+             manifest, run the jaxpr rule family; nonzero exit on any
+             drift or unsuppressed finding — the CI drift gate
+--json       machine-readable report on stdout
+--no-mesh    skip the mesh twin rows (debugging aid; the committed
+             manifest includes them)
+--no-rules   trace only (manifest work without the semantic pass)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubecensus")
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument("--write", action="store_true",
+                      help="regenerate COMPILE_MANIFEST.json")
+    mode.add_argument("--check", action="store_true",
+                      help="drift gate against the committed manifest "
+                           "(default)")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--no-mesh", action="store_true")
+    ap.add_argument("--no-rules", action="store_true")
+    ap.add_argument("--manifest", default=None,
+                    help="manifest path override (tests)")
+    args = ap.parse_args(argv)
+
+    from .census import run_census
+    from .manifest import (MANIFEST_PATH, diff_manifest, load_manifest,
+                           write_manifest)
+
+    res = run_census(with_mesh=not args.no_mesh,
+                     with_rules=not args.no_rules)
+    path = args.manifest or MANIFEST_PATH
+
+    if args.write:
+        out = write_manifest(res.rows, path)
+        report = {"written": out, "rows": len(res.rows),
+                  "findings": [f.to_json() for f in res.findings],
+                  "suppressed": [f.to_json() for f in res.suppressed]}
+        ok = not res.findings
+    else:
+        drift = diff_manifest(res.rows, load_manifest(path))
+        report = {"manifest": path, "rows": len(res.rows), "drift": drift,
+                  "findings": [f.to_json() for f in res.findings],
+                  "suppressed": [f.to_json() for f in res.suppressed]}
+        ok = (not res.findings and not drift["added"]
+              and not drift["removed"] and not drift["changed"]
+              and not drift.get("missing_manifest"))
+        report["clean"] = ok
+
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        if args.write:
+            print("wrote %s (%d rows)" % (report["written"], len(res.rows)))
+        else:
+            d = report["drift"]
+            if d.get("missing_manifest"):
+                print("no committed manifest at %s — run --write" % path)
+            for kind in ("added", "removed", "changed"):
+                for rid in d.get(kind, []):
+                    print("drift(%s): %s" % (kind, rid))
+        for f in res.findings:
+            print(str(f))
+        for f in res.suppressed:
+            print(str(f))
+        if not args.write:
+            print("census: %s (%d rows)"
+                  % ("clean" if ok else "FINDINGS/DRIFT", len(res.rows)))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

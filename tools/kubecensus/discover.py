@@ -1,0 +1,104 @@
+"""Jit-root discovery: prove the registry covers the whole compile
+surface, reusing kubelint's call-graph machinery (import-alias
+resolution, decorator/call-form jit detection).
+
+A *root* here is a function that owns its own XLA compile cache entry:
+a ``jax.jit``/``jax.pmap``-decorated def (including through
+``functools.partial``) or the target of a call-form ``jax.jit(f, ...)``.
+Bodies handed to ``vmap``/``lax.scan``/``while_loop`` etc. are traced
+INSIDE an enclosing root and never compile standalone, so they are not
+census entries (kubelint marks them traced; we deliberately filter them
+out).
+
+Any discovered root missing from the registry is a
+``census/unregistered-root`` finding: a new device program was added
+without extending the committed compile surface, so neither the manifest
+drift gate nor the AOT list knows it exists.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Set
+
+from .rules import Finding
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# transforms that create standalone compile-cache owners (vmap/grad etc.
+# only matter inside one of these)
+_COMPILING = {"jax.jit", "jax.pmap"}
+
+
+def discover_jit_roots(paths=("kubetpu",), root: str = None) -> Set[str]:
+    """Qualnames ("pkg.module:Qual.name") of every standalone jit root."""
+    from tools.kubelint.callgraph import CallGraph
+    from tools.kubelint.core import LintContext, load_modules
+
+    root = root or _REPO
+    abs_paths = [p if os.path.isabs(p) else os.path.join(root, p)
+                 for p in paths]
+    modules = load_modules(abs_paths, root=root)
+    cg = CallGraph(modules)
+    out: Set[str] = set()
+    for name, mi in cg.mods.items():
+        # decorated defs (incl. functools.partial(jax.jit, ...))
+        for fi in mi.by_node.values():
+            node = fi.node
+            for dec in getattr(node, "decorator_list", []):
+                target = dec
+                if isinstance(dec, ast.Call):
+                    d = cg.resolve_dotted(mi, dec.func)
+                    if d in ("functools.partial", "partial") and dec.args:
+                        target = dec.args[0]
+                    else:
+                        target = dec.func
+                if cg.resolve_dotted(mi, target) in _COMPILING:
+                    out.add(fi.qualname)
+        # call-form roots: jax.jit(f, ...) — f a local module-level def
+        # (Name), an imported def (Name through from-imports), or another
+        # module's def reached by attribute (`jax.jit(kernels.helper)`)
+        for call in ast.walk(mi.module.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            if cg.resolve_dotted(mi, call.func) not in _COMPILING:
+                continue
+            if not call.args:
+                continue
+            arg = call.args[0]
+            target = (mi.functions.get(arg.id)
+                      if isinstance(arg, ast.Name) else None)
+            if target is None:
+                target = _lookup_dotted(cg, cg.resolve_dotted(mi, arg))
+            if target is not None:
+                out.add(target.qualname)
+    return out
+
+
+def _lookup_dotted(cg, dotted):
+    """Dotted path ("kubetpu.ops.kernels.helper") -> that module's
+    top-level FunctionInfo, trying every module/attr split from the
+    right so package-qualified paths resolve."""
+    if not dotted:
+        return None
+    parts = dotted.split(".")
+    for i in range(len(parts) - 1, 0, -1):
+        mi = cg.mods.get(".".join(parts[:i]))
+        if mi is not None:
+            return mi.functions.get(".".join(parts[i:]))
+    return None
+
+
+def unregistered_roots(registered: Set[str],
+                       paths=("kubetpu",)) -> List[Finding]:
+    out = []
+    for qual in sorted(discover_jit_roots(paths)):
+        if qual not in registered:
+            out.append(Finding(
+                "census/unregistered-root", qual,
+                "jit root discovered by the call graph but absent from "
+                "the kubecensus registry — add a registry entry (or an "
+                "audited exemption) so the compile manifest covers it"))
+    return out

@@ -1,0 +1,172 @@
+"""COMPILE_MANIFEST.json: serialization, drift diffing, and the runtime
+compile-event cross-check.
+
+The committed manifest is the version-controlled compile surface.  Two
+consumers:
+
+* CI (``python -m tools.kubecensus --check``): regenerates the rows in
+  memory and fails on drift in either direction — a traced variant
+  absent from the committed file (surface grew silently) or a committed
+  row no trace reproduces (dead ladder bucket).
+* bench.py under ``BENCH_GATE=1``: every compile event the sanitize
+  watchdog observes for a REGISTERED kernel program must match a
+  manifest row.  Exact-shape matches pin census rungs; other events
+  match structurally (same program, same flattened arg count, same
+  per-arg dtype+rank) — the static census pins exact shapes per rung,
+  the runtime gate pins the variant STRUCTURE at serving shapes, and the
+  per-(program, shape) recompile watchdog covers shape churn in between.
+  Events for unregistered names (jax-internal eager ops, test helpers)
+  are counted but exempt; unregistered KERNEL roots cannot hide there
+  because the static census fails on them first
+  (census/unregistered-root).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+MANIFEST_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "COMPILE_MANIFEST.json")
+
+_AVAL_RE = re.compile(r"([A-Za-z_][A-Za-z_0-9]*)\[([\d,\s]*)\]")
+
+
+def row_id(row: dict) -> str:
+    tag = ":" + row["tag"] if row.get("tag") else ""
+    return "%s%s@%s" % (row["program"], tag, row["variant"])
+
+
+def write_manifest(rows: List[dict], path: str = None) -> str:
+    """Deterministic serialization: sorted rows, sorted keys, fixed
+    indent, trailing newline — regeneration over an unchanged tree is
+    byte-identical."""
+    path = path or MANIFEST_PATH
+    doc = {
+        "_comment": "Compile-surface census (tools/kubecensus). "
+                    "Regenerate: make census (python -m tools.kubecensus "
+                    "--write). CI fails on drift in either direction.",
+        "rows": rows,
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def load_manifest(path: str = None) -> Optional[List[dict]]:
+    path = path or MANIFEST_PATH
+    try:
+        with open(path) as f:
+            return json.load(f)["rows"]
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+def diff_manifest(current: List[dict],
+                  committed: Optional[List[dict]]) -> Dict[str, list]:
+    """Three-way drift: added (traced, not committed), removed (committed,
+    not reproduced — a dead ladder bucket), changed (same id, different
+    trace: avals, jaxpr hash, donation or statics moved)."""
+    if committed is None:
+        return {"added": [row_id(r) for r in current], "removed": [],
+                "changed": [], "missing_manifest": True}
+    cur = {row_id(r): r for r in current}
+    com = {row_id(r): r for r in committed}
+    added = sorted(set(cur) - set(com))
+    removed = sorted(set(com) - set(cur))
+    changed = []
+    watched = ("qualname", "in_avals", "compiled_in_avals", "out_avals",
+               "lowering_sha256", "donation", "static_sig", "sharding")
+    for rid in sorted(set(cur) & set(com)):
+        for k in watched:
+            if cur[rid].get(k) != com[rid].get(k):
+                changed.append("%s (%s)" % (rid, k))
+                break
+    return {"added": added, "removed": removed, "changed": changed}
+
+
+# ------------------------------------------------- runtime event matching
+
+
+def _parse_sig(sig: str) -> List[Tuple[str, int]]:
+    """'[ShapedArray(float32[8,16]), ...]' -> [(dtype, rank), ...]."""
+    out = []
+    for dt, dims in _AVAL_RE.findall(sig):
+        rank = 0 if not dims.strip() else len(dims.split(","))
+        out.append((dt, rank))
+    return out
+
+
+def match_compile_events(events: Dict[Tuple[str, str], int],
+                         rows: List[dict]) -> Dict[str, object]:
+    """Classify watchdog compile events against the manifest.
+
+    events: CompileWatchdog.counts — {(program, shapes-sig): count}.
+    Returns {kernel_events, matched_exact, matched_structural,
+    outside: [...], auxiliary} — ``outside`` non-empty means a registered
+    kernel program compiled a variant the manifest does not license."""
+    by_program: Dict[str, List[dict]] = {}
+    for r in rows:
+        by_program.setdefault(r["program"], []).append(r)
+    exact = {}
+    for r in rows:
+        exact.setdefault(
+            (r["program"], tuple(r.get("compiled_in_avals")
+                                 or r["in_avals"])), r)
+
+    kernel = matched_exact = matched_structural = auxiliary = 0
+    outside: List[str] = []
+    for (program, sig), _count in sorted(events.items()):
+        cands = by_program.get(program)
+        if cands is None:
+            auxiliary += 1
+            continue
+        kernel += 1
+        parsed = _parse_sig(sig)
+        sig_key = tuple("%s[%s]" % (dt, dims.replace(" ", ""))
+                        for dt, dims in _AVAL_RE.findall(sig))
+        if (program, sig_key) in exact:
+            matched_exact += 1
+            continue
+        if any(_structural_match(parsed, r) for r in cands):
+            matched_structural += 1
+            continue
+        outside.append("%s %s" % (program, sig))
+    return {"kernel_events": kernel, "matched_exact": matched_exact,
+            "matched_structural": matched_structural,
+            "auxiliary": auxiliary, "outside": outside}
+
+
+def _structural_match(parsed: List[Tuple[str, int]], row: dict) -> bool:
+    """The event's (dtype, rank) sequence must be an ORDERED SUBSEQUENCE
+    of the row's full (unpruned) call signature.
+
+    Why subsequence, not equality: jit prunes arguments the traced
+    program never reads, and the pruned set depends on batch CONTENT
+    (e.g. a wave with no preferred-affinity terms drops those weight
+    leaves) — so two legitimate compiles of one variant differ in which
+    leaves survive, but both are order-preserving subsets of the full
+    flatten.  A genuinely NEW argument structure (extra arrays, dtype
+    drift, reordered layout) cannot embed into the recorded signature
+    and stays ``outside``.  Exact-shape matching at the census rungs is
+    handled separately (compiled_in_avals equality)."""
+    want = []
+    for s in row["in_avals"]:
+        m = _AVAL_RE.match(s)
+        if not m:
+            return False     # non-array leaf recorded; never runtime-match
+        dt, dims = m.groups()
+        want.append((dt, 0 if not dims.strip() else len(dims.split(","))))
+    if len(parsed) > len(want):
+        return False
+    i = 0
+    for p in parsed:
+        while i < len(want) and want[i] != p:
+            i += 1
+        if i == len(want):
+            return False
+        i += 1
+    return True
