@@ -3,7 +3,7 @@
 # lint gate via tests/test_kubelint.py).  `make help` lists everything.
 
 .PHONY: help lint lock-graph test sanitize-test race-test flight-test \
-	delta-test census census-test trace bench
+	delta-test census census-test aot aot-test trace bench
 
 help:
 	@echo "kubetpu targets:"
@@ -30,6 +30,14 @@ help:
 	@echo "  make census-test    census suite: every jaxpr rule fires on a"
 	@echo "                      bad snippet, manifest idempotence, drift"
 	@echo "                      gate, runtime compile-event matching"
+	@echo "  make aot            compile + serialize every COMPILE_MANIFEST"
+	@echo "                      variant of the seamed serving programs into"
+	@echo "                      artifacts/aot (tools/kubeaot --build) and"
+	@echo "                      rewrite the committed AOT_INDEX.json"
+	@echo "  make aot-test       AOT suite: serialize/deserialize round trip"
+	@echo "                      with bit-identical placements, capture->serve"
+	@echo "                      signature hits, env-drift fallback, index"
+	@echo "                      gate, persistent-cache config coverage"
 	@echo "  make trace          run the pipelined drain with the flight"
 	@echo "                      recorder armed, write PIPELINE_TRACE.json +"
 	@echo "                      .perfetto.json, print the text flame summary"
@@ -79,6 +87,18 @@ census:
 census-test:
 	JAX_PLATFORMS=cpu python -m pytest \
 		tests/test_kubecensus.py -q -p no:cacheprovider
+
+# AOT executable artifacts (tools/kubeaot + kubetpu/utils/aot.py):
+# deploy-time jit(...).lower().compile() of every manifest variant of the
+# seamed serving programs, serialized via jax.experimental
+# .serialize_executable; nonzero exit on a capture failure or a
+# lowering-sha mismatch vs COMPILE_MANIFEST.json (the bit-identity oracle)
+aot:
+	JAX_PLATFORMS=cpu python -m tools.kubeaot --build
+
+aot-test:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_aot.py tests/test_compilation.py -q -p no:cacheprovider
 
 # pipelined-drain trace via the flight recorder + text flame summary
 # (PIPELINE_TRACE.json + PIPELINE_TRACE.perfetto.json for ui.perfetto.dev)

@@ -83,6 +83,40 @@ def make_pods(n: int, prefix: str = "pod-", namespace: str = "default",
     return out
 
 
+def restart_world(n_nodes: int, existing_per_node: int = 2,
+                  zones: int = 8):
+    """The deterministic warm-restart world: n_nodes zoned nodes, each
+    carrying existing_per_node bound pods with 16 app-group labels.
+    SHARED by bench.py warm_restart_case and tools/kubeaot build_shape —
+    a restart of shape (n_nodes, wave) dispatches byte-identical call
+    forms to a capture of the same shape only because both sides build
+    the world through this one function (same store insertion order,
+    same label vocab, same selector diversity)."""
+    from ..client.store import ClusterStore
+    store = ClusterStore()
+    for i, n in enumerate(make_nodes(n_nodes, zones=zones)):
+        store.add(n)
+        for p in make_pods(existing_per_node, prefix=f"ex-{i}-",
+                           group_labels=16):
+            p.spec.node_name = n.name
+            store.add(p)
+    return store
+
+
+def restart_wave(wave: int, prefix: str = "restart-") -> List[api.Pod]:
+    """The arriving wave of the warm-restart case: 16 app groups, 1/3
+    soft zone spread, 1/5 hostname anti-affinity (the blended
+    scheduler_perf topology mix).  Shared with tools/kubeaot build_shape
+    for the same reason as restart_world."""
+    pods = make_pods(wave, prefix=prefix, group_labels=16)
+    for i, p in enumerate(pods):
+        if i % 3 == 0:
+            with_spread(p, api.LABEL_ZONE, when="ScheduleAnyway")
+        if i % 5 == 0:
+            with_anti_affinity(p)
+    return pods
+
+
 def with_spread(pod: api.Pod, topo_key: str, max_skew: int = 1,
                 when: str = "DoNotSchedule",
                 match: Optional[Dict[str, str]] = None) -> api.Pod:

@@ -161,12 +161,30 @@ def _key_terms_mask(terms, k: int) -> jnp.ndarray:
     return (terms.topo_key == k) & terms.valid & terms.topo_known
 
 
-@functools.partial(jax.jit, static_argnames=("pad_pods_to", "pad_terms_to",
-                                             "extend_score_terms"))
 def materialize_assigned(cluster, batch, chosen, requested, nz, ports_used,
                          pad_pods_to: int = 0, pad_terms_to: int = 0,
                          extend_score_terms: bool = False,
                          hard_pod_affinity_weight: float = 1.0):
+    """Python entry for the jitted materialize — AOT seam (utils/aot.py):
+    armed, a signature hit runs the deserialized build-time executable;
+    disarmed this is the plain jit call.  See _materialize_assigned."""
+    from ..utils import aot
+    return aot.dispatch(
+        "_materialize_assigned", _materialize_assigned,
+        (cluster, batch, chosen, requested, nz, ports_used),
+        dict(pad_pods_to=pad_pods_to, pad_terms_to=pad_terms_to,
+             extend_score_terms=extend_score_terms,
+             hard_pod_affinity_weight=hard_pod_affinity_weight),
+        static_argnames=("pad_pods_to", "pad_terms_to",
+                         "extend_score_terms"))
+
+
+@functools.partial(jax.jit, static_argnames=("pad_pods_to", "pad_terms_to",
+                                             "extend_score_terms"))
+def _materialize_assigned(cluster, batch, chosen, requested, nz, ports_used,
+                          pad_pods_to: int = 0, pad_terms_to: int = 0,
+                          extend_score_terms: bool = False,
+                          hard_pod_affinity_weight: float = 1.0):
     """Fold a (partial) auction's placements into the cluster: assigned
     batch pods join the existing-pod axis at their nodes, their committed
     usage replaces requested/nonzero, and their registered hostPorts join
@@ -285,12 +303,20 @@ def schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
     # normalize it out of the static key
     if cfg.percentage_of_nodes_to_score != 100:
         cfg = cfg._replace(percentage_of_nodes_to_score=100)
-    return _schedule_gang(cluster, batch, cfg, rng, host_ok=host_ok,
-                          max_rounds=max_rounds,
-                          intra_batch_topology=intra_batch_topology,
-                          tie_index=tie_index,
-                          residual_window=residual_window,
-                          score_bias=score_bias)
+    # AOT seam (utils/aot.py): armed, a signature hit runs the
+    # deserialized build-time executable instead of tracing/compiling;
+    # disarmed this is the plain jit call through the same Python frame
+    from ..utils import aot
+    return aot.dispatch(
+        "_schedule_gang", _schedule_gang,
+        (cluster, batch, cfg, rng),
+        dict(host_ok=host_ok, max_rounds=max_rounds,
+             intra_batch_topology=intra_batch_topology,
+             tie_index=tie_index, residual_window=residual_window,
+             score_bias=score_bias),
+        static_argnums=(2,),
+        static_argnames=("max_rounds", "intra_batch_topology",
+                         "residual_window"))
 
 
 @functools.partial(jax.jit,

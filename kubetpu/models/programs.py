@@ -199,8 +199,20 @@ SCORE_SCALE = 1_000
 _SCORE_I32_MAX = float(2**31 - 128)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
 def explain_verdicts(cluster, batch, cfg: ProgramConfig, host_ok=None):
+    """Python entry for the jitted audit program — AOT seam (utils/aot.py):
+    armed, a signature hit runs the deserialized build-time executable;
+    disarmed this is the plain jit call.  See _explain_verdicts for the
+    program itself."""
+    from ..utils import aot
+    return aot.dispatch(
+        "_explain_verdicts", _explain_verdicts,
+        (cluster, batch, cfg), dict(host_ok=host_ok),
+        static_argnums=(2,))
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _explain_verdicts(cluster, batch, cfg: ProgramConfig, host_ok=None):
     """The per-pod decision audit program (DecisionLog feed): everything
     the host needs to say WHY a pod was (un)schedulable this cycle, in
     ONE packed [2F + 3, B] i32 readback (F = len(cfg.filters)):

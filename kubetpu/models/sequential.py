@@ -94,11 +94,18 @@ def schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
     workaround as gang.schedule_gang (one Python frame between callers and
     the jit object; see that docstring).  score_bias: optional [B, N] f32
     of weighted host-plugin scores (framework runner's Score/NormalizeScore
-    extension point) added to the device total before selectHost."""
-    return _schedule_sequential(
-        cluster, batch, cfg, rng,
-        hard_pod_affinity_weight=hard_pod_affinity_weight,
-        host_ok=host_ok, start_index=start_index, score_bias=score_bias)
+    extension point) added to the device total before selectHost.
+
+    AOT seam (utils/aot.py): armed, a signature hit runs the deserialized
+    build-time executable; disarmed this is the plain jit call."""
+    from ..utils import aot
+    return aot.dispatch(
+        "_schedule_sequential", _schedule_sequential,
+        (cluster, batch, cfg, rng),
+        dict(hard_pod_affinity_weight=hard_pod_affinity_weight,
+             host_ok=host_ok, start_index=start_index,
+             score_bias=score_bias),
+        static_argnums=(2,))
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=())

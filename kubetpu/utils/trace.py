@@ -341,6 +341,10 @@ class FlightRecorder:
                "device_wait_s": round(sum(
                    s.get("args", {}).get("device_wait_s", 0.0)
                    for s in spans), 3),
+               # per-cycle meta (pod_bucket, delta_rows, aot stats):
+               # tools/kubeaot --prune reads the bucket-hit set from here
+               "cycle_meta": [{"seq": r.seq, "label": r.label,
+                               "meta": dict(r.meta)} for r in recs],
                "spans": spans}
         if recs:
             doc["total_s"] = round(max((r.t1 or r.t0) for r in recs)

@@ -38,6 +38,14 @@ TPU_MODULES = {
 }
 
 
+def pytest_configure(config):
+    # tier-1 deselects these (ROADMAP verify runs -m 'not slow'); the
+    # heavyweight AOT end-to-end restart lives behind it (make aot-test
+    # runs everything)
+    config.addinivalue_line(
+        "markers", "slow: excluded from tier-1 (-m 'not slow')")
+
+
 @pytest.fixture(autouse=True)
 def _route_backend(request):
     mod = request.module.__name__.rsplit(".", 1)[-1]

@@ -1,0 +1,127 @@
+"""utils/compilation.py: the persistent-XLA-cache switch the serving path
+flips on by default.  Three branches, each with restart-cost consequences
+if it regresses: idempotency (a second enable must not clobber the active
+cache dir), the KUBETPU_XLA_CACHE_DIR override (deploys point the fleet
+at a shared prebuilt cache), and respect-existing-config (an embedding
+application's cache must win).  Plus the CompileTimer split the bench
+leans on for compile_s vs cache_load_s.
+"""
+import os
+import threading
+
+import pytest
+
+from kubetpu.utils import compilation
+
+
+@pytest.fixture
+def fresh_cache_state(monkeypatch):
+    """Reset the module latch and detach jax's cache config for the test,
+    restoring both afterwards — the process-global enable must not leak
+    between tests (or break the suite's real cache)."""
+    import jax
+    prev_dir = getattr(jax.config, "jax_compilation_cache_dir", None)
+    monkeypatch.setattr(compilation, "_enabled", None)
+    jax.config.update("jax_compilation_cache_dir", None)
+    yield
+    jax.config.update("jax_compilation_cache_dir", prev_dir)
+
+
+def test_enable_is_idempotent(tmp_path, fresh_cache_state, monkeypatch):
+    import jax
+    d1 = str(tmp_path / "one")
+    d2 = str(tmp_path / "two")
+    assert compilation.enable_persistent_cache(d1) == d1
+    assert jax.config.jax_compilation_cache_dir == d1
+    assert os.path.isdir(d1)
+    # second call is a no-op: returns the ACTIVE dir, does not re-point
+    assert compilation.enable_persistent_cache(d2) == d1
+    assert jax.config.jax_compilation_cache_dir == d1
+    assert not os.path.exists(d2)
+
+
+def test_env_override_wins_over_default(tmp_path, fresh_cache_state,
+                                        monkeypatch):
+    env_dir = str(tmp_path / "from-env")
+    monkeypatch.setenv("KUBETPU_XLA_CACHE_DIR", env_dir)
+    assert compilation.enable_persistent_cache() == env_dir
+    assert os.path.isdir(env_dir)
+
+
+def test_explicit_dir_beats_env(tmp_path, fresh_cache_state, monkeypatch):
+    monkeypatch.setenv("KUBETPU_XLA_CACHE_DIR", str(tmp_path / "env"))
+    explicit = str(tmp_path / "explicit")
+    assert compilation.enable_persistent_cache(explicit) == explicit
+
+
+def test_respects_existing_application_config(tmp_path, fresh_cache_state):
+    """An embedding application that already configured
+    jax_compilation_cache_dir keeps it — we adopt, never clobber."""
+    import jax
+    theirs = str(tmp_path / "theirs")
+    jax.config.update("jax_compilation_cache_dir", theirs)
+    got = compilation.enable_persistent_cache(str(tmp_path / "ours"))
+    assert got == theirs
+    assert jax.config.jax_compilation_cache_dir == theirs
+    # and the adoption is latched: later calls keep returning theirs
+    assert compilation.enable_persistent_cache() == theirs
+    assert not os.path.exists(tmp_path / "ours")
+
+
+def test_min_compile_thresholds_zeroed(tmp_path, fresh_cache_state):
+    """Every program is worth caching across restarts — the sub-second
+    kernels add up over a prewarm ladder."""
+    import jax
+    compilation.enable_persistent_cache(str(tmp_path / "c"))
+    assert jax.config.jax_persistent_cache_min_compile_time_secs == 0.0
+    assert jax.config.jax_persistent_cache_min_entry_size_bytes == 0
+
+
+# --------------------------------------------------------- CompileTimer
+
+
+def test_compile_timer_split_and_delta():
+    """compile_s is backend-total MINUS cache-retrieval (a cache hit's
+    backend_compile_duration IS the deserialization time), and delta()
+    attributes cost to a measured phase."""
+    from kubetpu.utils.sanitize import CompileTimer
+    t = CompileTimer()
+    t.on_duration("/jax/core/compile/backend_compile_duration", 5.0)
+    t.on_duration("/jax/compilation_cache/cache_retrieval_time_sec", 2.0)
+    t.on_event("/jax/compilation_cache/cache_hits")
+    t.on_event("/jax/compilation_cache/cache_misses")
+    s1 = t.snapshot()
+    assert s1["compile_s"] == 3.0 and s1["cache_load_s"] == 2.0
+    assert s1["cache_hits"] == 1 and s1["cache_misses"] == 1
+    t.on_duration("/jax/core/compile/backend_compile_duration", 1.5)
+    d = CompileTimer.delta(s1, t.snapshot())
+    assert d["compile_s"] == 1.5 and d["cache_load_s"] == 0.0
+    # the clamp: pure cache-load phases cannot report negative compile
+    t2 = CompileTimer()
+    t2.on_duration("/jax/compilation_cache/cache_retrieval_time_sec", 1.0)
+    t2.on_duration("/jax/core/compile/backend_compile_duration", 0.4)
+    assert t2.snapshot()["compile_s"] == 0.0
+
+
+def test_install_compile_timer_is_process_singleton():
+    from kubetpu.utils import sanitize
+    t1 = sanitize.install_compile_timer()
+    t2 = sanitize.install_compile_timer()
+    assert t1 is t2
+
+
+def test_compile_timer_thread_safety():
+    from kubetpu.utils.sanitize import CompileTimer
+    t = CompileTimer()
+
+    def hammer():
+        for _ in range(500):
+            t.on_duration("/jax/core/compile/backend_compile_duration",
+                          0.001)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert abs(t.snapshot()["compile_s"] - 2.0) < 1e-6

@@ -30,3 +30,9 @@ python -m tools.kubelint kubetpu/scheduler.py --rules delta --json
 # reproduces (a dead ladder bucket).  Regenerate after an intentional
 # surface change: make census (python -m tools.kubecensus --write).
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m tools.kubecensus --check --json
+# AOT artifact index gate (tools/kubeaot --check, pure JSON, no jax):
+# the committed AOT_INDEX.json and COMPILE_MANIFEST.json must share the
+# same census-family row keys in BOTH directions — an artifact with no
+# manifest row, or a manifest row with no artifact at census rungs,
+# fails.  Regenerate after an intentional surface change: make aot.
+python -m tools.kubeaot --check --json

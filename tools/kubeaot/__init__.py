@@ -1,0 +1,16 @@
+"""kubeaot: ahead-of-time executable artifacts for the scheduler.
+
+The build half of kubetpu/utils/aot.py.  ``python -m tools.kubeaot
+--build`` walks the kubecensus registry (the same builders the census
+traces), runs ``jit(...).lower().compile()`` for every manifest variant
+of the seamed serving programs — no execution — and serializes the
+compiled executables via ``jax.experimental.serialize_executable`` into
+a versioned artifact directory; ``--shape NxB`` captures a deploy-shaped
+serving ladder by running Scheduler.prewarm under a capture-mode
+runtime; ``--prune`` drops artifacts for ladder buckets the flight
+recorder never saw serve; ``--check`` is the pure-JSON CI gate that the
+committed AOT_INDEX.json and COMPILE_MANIFEST.json agree on row keys.
+
+See tools/kubeaot/README.md for the artifact key schema, the serve-time
+fallback ladder, and the pruning policy.
+"""

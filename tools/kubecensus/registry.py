@@ -211,13 +211,17 @@ def _explain_filters(w):
 
 def _explain_verdicts(w):
     from kubetpu.models import programs
-    return programs.explain_verdicts, (w.cluster, w.batch, w.cfg), {}
+    return programs._explain_verdicts, (w.cluster, w.batch, w.cfg), {}
 
 
 def _explain_verdicts_hostok(w):
     from kubetpu.models import programs
-    return (programs.explain_verdicts,
-            (w.cluster, w.batch, w.cfg, w.host_ok()), {})
+    # host_ok as KEYWORD, the serving seam's call form (scheduler prewarm
+    # and the audit path pass host_ok=...) — jit binds either spelling to
+    # the same avals, but the AOT signature keys on the call treedef, so
+    # a positional capture could never be hit by serving dispatch
+    return (programs._explain_verdicts,
+            (w.cluster, w.batch, w.cfg), {"host_ok": w.host_ok()})
 
 
 def _filter_verdicts(w):
@@ -339,7 +343,7 @@ def _materialize_assigned(w):
     e_next = pow2_bucket(int(w.cluster.filter_terms.valid.shape[0])
                          + w.B * ta)
     Np = int(w.cluster.ports.shape[1])
-    return (gang.materialize_assigned,
+    return (gang._materialize_assigned,
             (w.cluster, w.batch,
              np.zeros((w.B,), np.int32),                 # chosen
              np.asarray(w.cluster.requested),            # requested
@@ -437,9 +441,9 @@ ENTRIES: List[Entry] = [
           _schedule_batch, meshable=True, static_argnums=(2,)),
     Entry("explain_filters", "kubetpu.models.programs:explain_filters",
           _explain_filters, static_argnums=(2,)),
-    Entry("explain_verdicts", "kubetpu.models.programs:explain_verdicts",
+    Entry("_explain_verdicts", "kubetpu.models.programs:_explain_verdicts",
           _explain_verdicts, static_argnums=(2,)),
-    Entry("explain_verdicts", "kubetpu.models.programs:explain_verdicts",
+    Entry("_explain_verdicts", "kubetpu.models.programs:_explain_verdicts",
           _explain_verdicts_hostok, tag="hostok", static_argnums=(2,)),
     Entry("filter_verdicts", "kubetpu.models.programs:filter_verdicts",
           _filter_verdicts, static_argnums=(2,)),
@@ -467,7 +471,8 @@ ENTRIES: List[Entry] = [
     Entry("_schedule_sequential",
           "kubetpu.models.sequential:_schedule_sequential",
           _schedule_sequential_hostok, tag="hostok", static_argnums=(2,)),
-    Entry("materialize_assigned", "kubetpu.models.gang:materialize_assigned",
+    Entry("_materialize_assigned",
+          "kubetpu.models.gang:_materialize_assigned",
           _materialize_assigned,
           static_argnames=("pad_pods_to", "pad_terms_to",
                            "extend_score_terms")),
