@@ -3,7 +3,7 @@
 # lint gate via tests/test_kubelint.py).  `make help` lists everything.
 
 .PHONY: help lint lock-graph test sanitize-test race-test flight-test \
-	delta-test census census-test aot aot-test trace bench
+	delta-test census census-test aot aot-test pallas-test trace bench
 
 help:
 	@echo "kubetpu targets:"
@@ -38,6 +38,11 @@ help:
 	@echo "                      with bit-identical placements, capture->serve"
 	@echo "                      signature hits, env-drift fallback, index"
 	@echo "                      gate, persistent-cache config coverage"
+	@echo "  make pallas-test    Pallas megakernel differential suite:"
+	@echo "                      lax-vs-pallas-interpret bit-match oracle"
+	@echo "                      (randomized churned clusters + goldens +"
+	@echo "                      compile-once watchdog); reasoned skip when"
+	@echo "                      pallas is unavailable"
 	@echo "  make trace          run the pipelined drain with the flight"
 	@echo "                      recorder armed, write PIPELINE_TRACE.json +"
 	@echo "                      .perfetto.json, print the text flame summary"
@@ -99,6 +104,15 @@ aot:
 aot-test:
 	JAX_PLATFORMS=cpu python -m pytest \
 		tests/test_aot.py tests/test_compilation.py -q -p no:cacheprovider
+
+# Pallas megakernel (kubetpu/ops/pallas_kernels.py): the fused
+# filter->score->propose auction round vs the lax oracle, interpret=True
+# on CPU; `make bench` adds the backend_compare case with the round
+# histogram.  Environments without jax.experimental.pallas skip with a
+# reason, never fail.
+pallas-test:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_pallas_gang.py -q -m 'not slow' -p no:cacheprovider
 
 # pipelined-drain trace via the flight recorder + text flame summary
 # (PIPELINE_TRACE.json + PIPELINE_TRACE.perfetto.json for ui.perfetto.dev)

@@ -135,9 +135,19 @@ def _spread(raw):
             "max_s": round(max(raw), 3)}
 
 
+def _rounds_hist(cycle_rounds):
+    """Per-cycle auction round HISTOGRAM {rounds: cycles} — the shape of
+    the round distribution, not just its max, so a megakernel/windowing
+    change that shifts the tail is visible in the committed JSON."""
+    hist = {}
+    for r in cycle_rounds:
+        hist[str(int(r))] = hist.get(str(int(r)), 0) + 1
+    return dict(sorted(hist.items(), key=lambda kv: int(kv[0])))
+
+
 def run_mode(mode, n_nodes, n_pods, existing_per_node, repeats,
              mesh_shape=None, batch_cap=None, chain=None, ipa_heavy=False,
-             pipeline=False):
+             pipeline=False, kernel_backend="lax"):
     """One full e2e measurement: fresh store + scheduler per attempt; the
     first attempt pays XLA compiles (bounded by the persistent cache),
     later attempts reuse the in-process jit cache.  Pod counts above
@@ -173,7 +183,7 @@ def run_mode(mode, n_nodes, n_pods, existing_per_node, repeats,
             profiles=[KubeSchedulerProfile()],
             batch_size=min(n_pods, batch_cap), mode=mode,
             mesh_shape=mesh_shape, chain_cycles=chain,
-            pipeline_cycles=pipeline)
+            pipeline_cycles=pipeline, kernel_backend=kernel_backend)
         sched = Scheduler(store, config=cfg, async_binding=False)
         for p in pending:
             store.add(p)
@@ -222,6 +232,8 @@ def run_mode(mode, n_nodes, n_pods, existing_per_node, repeats,
             stats["cache_load_s"] = compile_split["cache_load_s"]
         if mode == "gang":
             stats["auction_rounds_max"] = max(cycle_rounds, default=0)
+            stats["auction_rounds_hist"] = _rounds_hist(cycle_rounds)
+            stats["kernel_backend"] = kernel_backend
             # analytic matmul-FLOP lower bound (kubetpu/utils/flops.py):
             # achieved TFLOP/s over the readback-observed device time, MFU
             # vs the chip's bf16 peak.  In pipelined mode device execution
@@ -363,6 +375,13 @@ def northstar_gate(detail, path="NORTHSTAR.json"):
         failures.append(
             "warm_restart: restart-mode placements diverged (cold / "
             "cache-warm / aot-artifact must be bit-identical)")
+    # same contract for the kernel backends: the lax path is the Pallas
+    # megakernel's bit-match oracle — divergence is a correctness failure
+    # on every jax backend, perf floors or not
+    if detail.get("backend_compare", {}).get("placements_match") is False:
+        failures.append(
+            "backend_compare: pallas placements diverged from the lax "
+            "oracle (bit-identity contract, ops/pallas_kernels.py)")
     try:
         with open(path) as f:
             doc = json.load(f)
@@ -776,6 +795,76 @@ def rescore_case(n_pods=51200, n_nodes=10240, chunk=4096):
     return out
 
 
+def backend_compare_case(n_nodes=512, n_pods=2048, existing_per_node=2,
+                         batch_cap=1024):
+    """kernel_backend comparison (ROADMAP item 3): the SAME deterministic
+    TERM-FREE world — the Pallas megakernel's supported surface, where
+    needs_topo routes intra_batch_topology=False — drained once per
+    backend.  Placements must be BIT-IDENTICAL (the lax path is the
+    oracle); under BENCH_GATE a mismatch fails the run like
+    warm_restart's placements_match, with no recorded floor needed.  On
+    CPU the pallas path runs interpret=True so its seconds carry no perf
+    claim (parity only); the JSON schema carries kernel_backend + the
+    per-cycle round histogram either way, so a TPU run can gate
+    device_wait_s / round-count wins without schema churn."""
+    import jax
+
+    from kubetpu.apis.config import (KubeSchedulerConfiguration,
+                                     KubeSchedulerProfile)
+    from kubetpu.client.store import ClusterStore
+    from kubetpu.harness import hollow
+    from kubetpu.scheduler import Scheduler
+    from kubetpu.utils import pallas_backend as PB
+
+    def run(backend):
+        store = ClusterStore()
+        for i, n in enumerate(hollow.make_nodes(n_nodes, zones=8)):
+            store.add(n)
+            for p in hollow.make_pods(existing_per_node, prefix=f"ex-{i}-",
+                                      group_labels=16):
+                p.spec.node_name = n.name
+                store.add(p)
+        # group_labels=0: no controller spread selectors, no topology
+        # terms — the batch shape the megakernel serves
+        pending = hollow.make_pods(n_pods, prefix="pend-", group_labels=0)
+        cfg = KubeSchedulerConfiguration(
+            profiles=[KubeSchedulerProfile()],
+            batch_size=min(n_pods, batch_cap), mode="gang",
+            kernel_backend=backend)
+        sched = Scheduler(store, config=cfg, async_binding=False)
+        for p in pending:
+            store.add(p)
+        sched.device_wait_s = 0.0
+        placements = {}
+        rounds = []
+        t0 = time.time()
+        while True:
+            out = sched.schedule_pending(timeout=0.2)
+            if not out:
+                break
+            rounds.append(sched.last_gang_rounds)
+            for o in out:
+                placements[o.pod.metadata.name] = o.node
+        dt = time.time() - t0
+        stats = {"kernel_backend": backend,
+                 "e2e_s": round(dt, 3),
+                 "device_wait_s": round(sched.device_wait_s, 3),
+                 "placed": sum(1 for v in placements.values() if v),
+                 "auction_rounds_max": max(rounds, default=0),
+                 "auction_rounds_hist": _rounds_hist(rounds)}
+        sched.close()
+        return placements, stats
+
+    PB.reset_fallbacks()
+    p_lax, s_lax = run("lax")
+    p_pal, s_pal = run("pallas")
+    s_pal["fallbacks"] = PB.fallback_counts()
+    return {"nodes": n_nodes, "pods": n_pods,
+            "interpret_mode": jax.default_backend() != "tpu",
+            "lax": s_lax, "pallas": s_pal,
+            "placements_match": bool(p_lax) and p_lax == p_pal}
+
+
 def main() -> None:
     n_nodes = int(os.environ.get("BENCH_NODES", "1000"))
     n_pods = int(os.environ.get("BENCH_PODS", "4096"))
@@ -892,6 +981,14 @@ def main() -> None:
             detail["preemption"] = preemption_case()
         except Exception as e:  # pragma: no cover - depends on device state
             detail["preemption"] = {"error": repr(e)}
+
+    if (os.environ.get("BENCH_BACKENDS", "1") == "1"
+            and mesh_shape is None):
+        try:
+            detail["backend_compare"] = backend_compare_case(
+                n_nodes=min(n_nodes, 512), n_pods=min(n_pods, 2048))
+        except Exception as e:  # pragma: no cover - depends on device state
+            detail["backend_compare"] = {"error": repr(e)}
 
     if full:
         northstar = {}

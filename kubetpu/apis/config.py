@@ -102,6 +102,18 @@ class KubeSchedulerConfiguration:
     # exact capacity/hostPort semantics, topology scored against the
     # snapshot rather than intra-batch placements.
     mode: str = "sequential"
+    # Device kernel backend for the gang auction's round loop:
+    # "lax"    — the reference path: XLA-fused but stage-separate filter /
+    #            score / propose programs (also the bit-match oracle).
+    # "pallas" — the fused filter→score→propose megakernel
+    #            (kubetpu/ops/pallas_kernels.py): per auction round the
+    #            [B, N_tile] mask/score blocks stay in VMEM and only
+    #            [B]-sized proposals return to HBM.  Engages only for the
+    #            supported surface (term-free batches, default score
+    #            family — utils/pallas_backend.unsupported_reason);
+    #            anything else falls back to lax with a recorded reason,
+    #            and placements are bit-identical either way.
+    kernel_backend: str = "lax"
     mesh_shape: Optional[tuple] = None
     # Cycle chaining (gang mode): reuse the auction's materialized cluster
     # as the next cycle's snapshot tensors instead of re-tensorizing

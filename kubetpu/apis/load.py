@@ -61,6 +61,7 @@ def load_config(doc: Dict[str, Any]) -> KubeSchedulerConfiguration:
     cfg.extenders = list(doc.get("extenders", []) or [])
     cfg.batch_size = doc.get("batchSize", 256)  # TPU extension
     cfg.mode = doc.get("mode", "sequential")    # TPU extension
+    cfg.kernel_backend = doc.get("kernelBackend", "lax")  # TPU extension
     cfg.profiles = [_decode_profile(p) for p in doc.get("profiles", [])]
     apply_defaults(cfg)
     validate(cfg)
@@ -120,6 +121,8 @@ def validate(cfg: KubeSchedulerConfiguration,
         errs.append("podInitialBackoffSeconds must be > 0")
     if cfg.mode not in ("sequential", "gang"):
         errs.append("mode must be 'sequential' or 'gang'")
+    if cfg.kernel_backend not in ("lax", "pallas"):
+        errs.append("kernelBackend must be 'lax' or 'pallas'")
     if cfg.pod_max_backoff_seconds < cfg.pod_initial_backoff_seconds:
         errs.append("podMaxBackoffSeconds must be >= podInitialBackoffSeconds")
     names = [p.scheduler_name for p in cfg.profiles]

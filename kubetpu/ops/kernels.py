@@ -582,14 +582,14 @@ def interpod_score_pre(cluster, batch) -> InterpodScorePre:
         em=existing_terms_match(cluster.score_terms, batch))
 
 
-def interpod_score(cluster, batch, feasible,
-                   pre: InterpodScorePre | None = None,
-                   active_keys=None) -> jnp.ndarray:
-    """InterPodAffinity scoring, already normalized (reference: scoring.go).
-
-    Node-space formulation: the (topologyKey, value) -> weight map becomes
-    per-node weighted same-pair sums — MXU matmuls with bf16-exact inputs
-    (weights are ints |w| <= 100; accumulation is f32)."""
+def interpod_score_raw(cluster, batch,
+                       pre: InterpodScorePre | None = None,
+                       active_keys=None):
+    """The assignment-dependent RAW half of interpod_score -> (raw [B, N],
+    any_counts [B, 1]).  Split out so gang mode's Pallas backend can
+    precompute it once per auction (under intra_batch_topology=False the
+    pod axis is frozen, so raw is round-invariant) and fuse only the
+    feasibility-dependent normalization into the megakernel."""
     B = batch.req.shape[0]
     N = cluster.allocatable.shape[0]
     if pre is None:
@@ -625,10 +625,24 @@ def interpod_score(cluster, batch, feasible,
 
     raw = raw1 + raw2
 
-    # NormalizeScore (scoring.go:237-271): min/max start at 0; skip entirely
-    # when the topologyScore map is empty.  Every counted pair lives on at
-    # least its owner's node, so "map empty" == "raw zero at every node".
+    # NormalizeScore skips entirely when the topologyScore map is empty.
+    # Every counted pair lives on at least its owner's node, so "map
+    # empty" == "raw zero at every node".
     any_counts = jnp.any(raw != 0, axis=1, keepdims=True)
+    return raw, any_counts
+
+
+def interpod_score(cluster, batch, feasible,
+                   pre: InterpodScorePre | None = None,
+                   active_keys=None) -> jnp.ndarray:
+    """InterPodAffinity scoring, already normalized (reference: scoring.go).
+
+    Node-space formulation: the (topologyKey, value) -> weight map becomes
+    per-node weighted same-pair sums — MXU matmuls with bf16-exact inputs
+    (weights are ints |w| <= 100; accumulation is f32)."""
+    raw, any_counts = interpod_score_raw(cluster, batch, pre=pre,
+                                         active_keys=active_keys)
+    # NormalizeScore (scoring.go:237-271): min/max start at 0
     big = jnp.float32(2**62)
     max_c = jnp.maximum(jnp.max(jnp.where(feasible, raw, -big), axis=1,
                                 keepdims=True), 0.0)

@@ -1,0 +1,541 @@
+"""Fused filter -> score-combine -> auction-propose Pallas megakernel.
+
+The gang auction's round loop (models/gang.py round_step) is a chain of
+XLA-fused-but-separate stages: NodeResourcesFit + NodePorts feasibility
+materialize a [B, N] mask in HBM, run_scores materializes the [B, N]
+weighted score matrix, and the propose step re-reads both to pick each
+pod's argmax node.  Every auction round pays that HBM round trip
+(auction_rounds_max is 4-13 at BENCH/MULTICHIP shapes), and the serial
+round dependency — not FLOPs — bounds cycle latency.
+
+This module is the Pallas beachhead for ROADMAP item 3: ONE kernel, tiled
+over the node axis, that per [TB, TN] tile
+
+  (a) computes the feasibility mask (static filter mask AND'd with the
+      fit verdict against the round's committed usage and the hostPort
+      conflict against the round's registered ports),
+  (b) combines the weighted plugin scores (resource scorers from the
+      evolving requested/nonzero carries; normalization-family scorers
+      from per-pod statistics accumulated in a first grid phase), and
+  (c) runs the propose step of the bidding round (masked score max +
+      selectHost gumbel tie-break argmax),
+
+with the per-tile [B, N_tile] score block living entirely in VMEM: per
+round, HBM traffic is the carry reads plus three [B]-sized outputs — the
+[B, N] mask/score intermediates never exist off-chip.  Admission stays on
+the existing segmented-reduce logic in models/gang.py (it is O(B), not
+O(B*N)), as does round 0 (whose [B, N] feasibility IS a GangResult
+diagnostic output).  What remains for a later PR is full auction-LOOP
+residency: the while_loop still lives at lax level, so score tiles are
+re-streamed per round rather than pinned across rounds.
+
+Bit-match oracle contract
+-------------------------
+The lax path is the oracle: for any supported (cfg, batch) this kernel's
+(prop, active, best) are BIT-IDENTICAL to round_step's propose half.
+Three properties make that tractable:
+
+  * selectHost tie-breaks decompose: jax.random.categorical(key, logits)
+    == argmax(gumbel(key, shape) + logits), and with the auction's
+    0 / -2**62 logits the sum is exactly ``where(tie, gumbel, -2**62)``
+    in f32 — so the gumbel matrix is precomputed ONCE from the same
+    fold_in keys and the kernel only needs a cross-tile argmax whose
+    first-index tie-break matches jnp.argmax.
+  * every cross-node reduction the supported score family needs is
+    either a float max/min (exactly associative) or a sum of
+    integer-valued f32 (exact in any order below 2**24): per-pod
+    normalization stats accumulate tile-by-tile without rounding drift.
+  * everything else is elementwise, reusing the SAME jnp formula
+    helpers as the lax kernels (balanced_formula/least_formula/...), so
+    each element sees an identical f32 op sequence.
+
+Supported surface (see kubetpu/utils/pallas_backend.unsupported_reason):
+intra_batch_topology=False rounds (the host already routes term-free
+batches there), score plugins whose feasibility dependence is per-pod
+stats — the full default family.  PodTopologySpread soft scoring is
+supported via its no-soft-constraints constant path (MaxNodeScore on
+every feasible node), which is exactly what a term-free batch evaluates
+to; batches carrying soft constraints fall back in the dispatcher (the
+scheduler's needs_topo gate routes them away anyway, and the
+schedule_gang wrapper's host-side batch inspection catches direct
+callers — reason "soft-spread-constraints").
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import kernels as K
+from ..state.tensors import CH_CPU, CH_MEM, CH_PODS, N_FIXED_CHANNELS
+from ..utils.intern import pow2_bucket
+
+try:  # capability probe: pallas is absent on some jaxlib builds
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    HAVE_PALLAS = True
+except Exception:  # pragma: no cover - environment-dependent
+    pl = None
+    pltpu = None
+    HAVE_PALLAS = False
+
+_NEG = float(-2**62)
+_BIG = float(2**62)
+MAX_NODE_SCORE = K.MAX_NODE_SCORE
+
+# score plugins whose raw matrix is round-invariant under
+# intra_batch_topology=False and enters the kernel as a plane
+_PLANE_OF = {
+    "ImageLocality": "raw:ImageLocality",
+    "NodeAffinity": "raw:NodeAffinity",
+    "NodePreferAvoidPods": "raw:NodePreferAvoidPods",
+    "TaintToleration": "raw:TaintToleration",
+    "InterPodAffinity": "ipa_raw",
+    "DefaultPodTopologySpread": "dps_raw",
+}
+
+# the full supported score family; anything else falls back to lax
+SUPPORTED_SCORES = frozenset(_PLANE_OF) | frozenset({
+    "NodeResourcesBalancedAllocation",
+    "NodeResourcesLeastAllocated",
+    "NodeResourcesMostAllocated",
+    "PodTopologySpread",  # no-soft-constraints constant path (see above)
+})
+
+_LANE = 128  # TPU lane width: the natural node-tile quantum
+
+
+def plane_order(cfg, has_bias: bool) -> Tuple[str, ...]:
+    """Static plane layout of the stacked [S, B, N] input: score raws in
+    cfg.scores order, then the optional host score bias, then the
+    selectHost gumbel matrix (always last)."""
+    names = []
+    for name, _ in cfg.scores:
+        key = _PLANE_OF.get(name)
+        if key is not None and key not in names:
+            names.append(key)
+    if has_bias:
+        names.append("bias")
+    names.append("gumbel")
+    return tuple(names)
+
+
+def build_bundle(cluster, batch, cfg, static_ok, ports_ok0, score_pre,
+                 score_bias, gumbel) -> Dict[str, jnp.ndarray]:
+    """Precompute the megakernel's round-invariant inputs, once per
+    auction (traced inside _schedule_gang).  All [B, N] planes here are
+    assignment-independent under intra_batch_topology=False: the pod axis
+    is frozen during the loop, so interpod/default-spread raws are
+    round-invariant even though their lax twins recompute per round."""
+    B = batch.req.shape[0]
+    planes: Dict[str, jnp.ndarray] = {}
+    ipa_any = jnp.zeros((B,), bool)
+    for name, _ in cfg.scores:
+        if name == "InterPodAffinity" and "ipa_raw" not in planes:
+            raw, any_counts = K.interpod_score_raw(
+                cluster, batch, pre=score_pre.get("interpod_score"),
+                active_keys=cfg.active_keys)
+            planes["ipa_raw"] = raw
+            ipa_any = any_counts[:, 0]
+        elif name == "DefaultPodTopologySpread" and "dps_raw" not in planes:
+            planes["dps_raw"] = K.default_spread_score(
+                cluster, batch, match_ns=score_pre.get("default_spread"))
+        elif name in _PLANE_OF and _PLANE_OF[name] not in planes:
+            planes[_PLANE_OF[name]] = score_pre["raw:" + name]
+    if score_bias is not None:
+        planes["bias"] = score_bias
+    planes["gumbel"] = gumbel
+    order = plane_order(cfg, score_bias is not None)
+    stack = jnp.stack([planes[k].astype(jnp.float32) for k in order])
+    zone = cluster.zone_hot
+    if zone.shape[1] == 0:
+        zone = jnp.zeros((zone.shape[0], 1), jnp.float32)
+    return dict(
+        planes=stack,                         # [S, B, N] f32
+        mask=static_ok & ports_ok0,           # [B, N] bool
+        ipa_any=ipa_any,                      # [B] bool
+        skip=batch.spread_skip,               # [B] bool
+        breq=batch.req,                       # [B, R] f32
+        bnz=batch.nonzero_req,                # [B, 2] f32
+        bports=batch.ports_hot,               # [B, P] f32
+        alloc=cluster.allocatable,            # [N, R] f32 (node side)
+        zone=zone,                            # [N, Z] f32 (node side)
+    )
+
+
+_POD_SIDE = ("planes", "mask", "ipa_any", "skip", "breq", "bnz", "bports")
+
+
+def gather_bundle(bundle: Dict[str, jnp.ndarray], rows: jnp.ndarray,
+                  B: int) -> Dict[str, jnp.ndarray]:
+    """Row-gather the pod-side bundle tensors for a windowed sub-round.
+    Sentinel rows (>= B) clip to row B-1; the caller's `live` vector is
+    False there, so the kernel proposes the no-op segment for them."""
+    rsafe = jnp.clip(rows, 0, B - 1)
+    out = dict(bundle)
+    for k in _POD_SIDE:
+        axis = 1 if k == "planes" else 0
+        out[k] = jnp.take(bundle[k], rsafe, axis=axis)
+    return out
+
+
+class _Layout(NamedTuple):
+    """Static kernel layout, derived once per trace."""
+    scores: Tuple[Tuple[str, float], ...]
+    planes: Tuple[str, ...]
+    use_fit: bool
+    use_ports: bool
+    stat_cols: Tuple[Tuple[str, int], ...]
+    n_stats: int
+    W: int
+    N: int
+    R: int
+    P: int
+    Z: int
+    TB: int
+    TN: int
+    NT: int
+
+
+def _layout(cfg, has_bias: bool, W: int, N: int, R: int, P: int,
+            Z: int) -> _Layout:
+    filters = set(cfg.filters)
+    cols = []
+    for name, _ in cfg.scores:
+        if name == "NodeAffinity":
+            cols.append("max_na")
+        elif name == "TaintToleration":
+            cols.append("max_tt")
+        elif name == "InterPodAffinity":
+            cols += ["max_ip", "min_ip"]
+        elif name == "DefaultPodTopologySpread":
+            cols += ["max_dps", "havez"]
+    cols += ["act", "best", "hh"]
+    stat_cols = tuple((c, i) for i, c in enumerate(dict.fromkeys(cols)))
+    TB = min(_LANE, pow2_bucket(max(W, 1), 1))
+    TN = min(_LANE, pow2_bucket(max(N, 1), 1))
+    return _Layout(
+        scores=tuple((n, float(w)) for n, w in cfg.scores),
+        planes=plane_order(cfg, has_bias),
+        use_fit="NodeResourcesFit" in filters,
+        use_ports="NodePorts" in filters,
+        stat_cols=stat_cols, n_stats=len(stat_cols),
+        W=W, N=N, R=R, P=P, Z=Z, TB=TB, TN=TN,
+        NT=-(-N // TN))
+
+
+def _make_kernel(L: _Layout):
+    """Build the kernel body for one static layout.  Phase 0 sweeps the
+    node tiles accumulating the per-pod normalization statistics; phase 1
+    re-derives feasibility (VPU recompute is cheaper than an HBM round
+    trip), combines the weighted scores and folds the propose argmax."""
+    col = {name: i for name, i in L.stat_cols}
+    plane = {name: i for i, name in enumerate(L.planes)}
+
+    def kernel(planes_ref, mask_ref, alloc_ref, zone_ref, req_ref, nz_ref,
+               pu_ref, breq_ref, bnz_ref, bports_ref, live_ref, skip_ref,
+               ipaany_ref, prop_ref, best_ref, act_ref, stats, czone, idxs):
+        p = pl.program_id(0)
+        b = pl.program_id(1)
+        n = pl.program_id(2)
+        sl = pl.ds(b * L.TB, L.TB)
+        col_ok = (n * L.TN + jax.lax.broadcasted_iota(
+            jnp.int32, (L.TB, L.TN), 1)) < L.N
+
+        def feas_tile():
+            f = mask_ref[...] & live_ref[...][:, None] & col_ok
+            breq = breq_ref[...]
+            if L.use_fit:
+                alloc = alloc_ref[...]
+                used = req_ref[...]
+                pods_ok = (alloc[:, CH_PODS][None, :]
+                           >= breq[:, CH_PODS][:, None]
+                           + used[:, CH_PODS][None, :])
+                res_ok = jnp.ones((L.TB, L.TN), bool)
+                zero_req = jnp.ones((L.TB,), bool)
+                for r in range(L.R):
+                    if r == CH_PODS:
+                        continue
+                    free_ok = (alloc[:, r][None, :]
+                               >= breq[:, r][:, None] + used[:, r][None, :])
+                    if r < N_FIXED_CHANNELS:
+                        res_ok = res_ok & free_ok
+                    else:
+                        res_ok = res_ok & (free_ok
+                                           | (breq[:, r] <= 0)[:, None])
+                    zero_req = zero_req & (breq[:, r] == 0)
+                f = f & pods_ok & (zero_req[:, None] | res_ok)
+            if L.use_ports:
+                conflict = jnp.dot(bports_ref[...], pu_ref[...].T,
+                                   preferred_element_type=jnp.float32) > 0.5
+                f = f & ~conflict
+            return f
+
+        def resource_fracs():
+            bnz = bnz_ref[...]
+            nzc = nz_ref[...]
+            alloc = alloc_ref[...]
+            req_cpu = nzc[:, 0][None, :] + bnz[:, 0][:, None]
+            req_mem = nzc[:, 1][None, :] + bnz[:, 1][:, None]
+            alloc_cpu = jnp.broadcast_to(alloc[:, CH_CPU][None, :],
+                                         (L.TB, L.TN))
+            alloc_mem = jnp.broadcast_to(alloc[:, CH_MEM][None, :],
+                                         (L.TB, L.TN))
+            return req_cpu, req_mem, alloc_cpu, alloc_mem
+
+        def zone_tile():
+            ztile = zone_ref[...]
+            cok = (n * L.TN + jax.lax.broadcasted_iota(
+                jnp.int32, (L.TN, 1), 0).reshape(L.TN)) < L.N
+            return jnp.where(cok[:, None], ztile, 0.0)
+
+        # ---- phase 0: per-pod normalization statistics -----------------
+        @pl.when(p == 0)
+        def _():
+            f = feas_tile()
+
+            def acc(name, tile_val, comb):
+                c = col[name]
+
+                @pl.when(n == 0)
+                def _():
+                    stats[sl, c] = tile_val
+
+                @pl.when(n > 0)
+                def _():
+                    stats[sl, c] = comb(stats[sl, c], tile_val)
+
+            # bool -> f32 cast, not where(f, 1.0, 0.0): two python-float
+            # branches commit the default float dtype, which is f64
+            # wherever x64 is enabled (census/f64-promotion)
+            acc("act", jnp.max(f.astype(jnp.float32), axis=1),
+                jnp.maximum)
+            if "max_na" in col:
+                raw = planes_ref[plane["raw:NodeAffinity"]]
+                acc("max_na", jnp.max(jnp.where(f, raw, _NEG), axis=1),
+                    jnp.maximum)
+            if "max_tt" in col:
+                raw = planes_ref[plane["raw:TaintToleration"]]
+                acc("max_tt", jnp.max(jnp.where(f, raw, _NEG), axis=1),
+                    jnp.maximum)
+            if "max_ip" in col:
+                raw = planes_ref[plane["ipa_raw"]]
+                acc("max_ip", jnp.max(jnp.where(f, raw, _NEG), axis=1),
+                    jnp.maximum)
+                acc("min_ip", jnp.min(jnp.where(f, raw, _BIG), axis=1),
+                    jnp.minimum)
+            if "max_dps" in col:
+                raw = planes_ref[plane["dps_raw"]]
+                zt = zone_tile()
+                acc("max_dps", jnp.max(jnp.where(f, raw, _NEG), axis=1),
+                    jnp.maximum)
+                has_zone = jnp.any(zt > 0, axis=1)
+                acc("havez",
+                    jnp.max((f & has_zone[None, :]).astype(jnp.float32),
+                            axis=1), jnp.maximum)
+                cz = jnp.dot(jnp.where(f, raw, 0.0), zt,
+                             preferred_element_type=jnp.float32)
+
+                @pl.when(n == 0)
+                def _():
+                    czone[sl, :] = cz
+
+                @pl.when(n > 0)
+                def _():
+                    czone[sl, :] = czone[sl, :] + cz
+
+        # ---- phase 1: score combine + propose --------------------------
+        @pl.when(p == 1)
+        def _():
+            f = feas_tile()
+            total = jnp.zeros((L.TB, L.TN), jnp.float32)
+            for name, weight in L.scores:
+                if name == "NodeResourcesBalancedAllocation":
+                    s = K.balanced_formula(*resource_fracs())
+                elif name == "NodeResourcesLeastAllocated":
+                    rc, rm, ac, am = resource_fracs()
+                    s = K._idiv(K.least_formula(rc, ac) * 1.0
+                                + K.least_formula(rm, am) * 1.0, 2.0)
+                elif name == "NodeResourcesMostAllocated":
+                    rc, rm, ac, am = resource_fracs()
+                    s = K._idiv(K.most_formula(rc, ac) * 1.0
+                                + K.most_formula(rm, am) * 1.0, 2.0)
+                elif name == "ImageLocality":
+                    s = planes_ref[plane["raw:ImageLocality"]]
+                elif name == "NodePreferAvoidPods":
+                    s = planes_ref[plane["raw:NodePreferAvoidPods"]]
+                elif name == "NodeAffinity":
+                    raw = planes_ref[plane["raw:NodeAffinity"]]
+                    max_c = jnp.maximum(stats[sl, col["max_na"]], 0.0)
+                    scaled = K._idiv(MAX_NODE_SCORE * raw,
+                                     jnp.maximum(max_c, 1.0)[:, None])
+                    s = jnp.where((max_c > 0)[:, None], scaled, 0.0)
+                elif name == "TaintToleration":
+                    raw = planes_ref[plane["raw:TaintToleration"]]
+                    max_c = jnp.maximum(stats[sl, col["max_tt"]], 0.0)
+                    scaled = MAX_NODE_SCORE - K._idiv(
+                        MAX_NODE_SCORE * raw,
+                        jnp.maximum(max_c, 1.0)[:, None])
+                    s = jnp.where((max_c > 0)[:, None], scaled,
+                                  MAX_NODE_SCORE)
+                elif name == "InterPodAffinity":
+                    raw = planes_ref[plane["ipa_raw"]]
+                    max_c = jnp.maximum(stats[sl, col["max_ip"]], 0.0)
+                    min_c = jnp.minimum(stats[sl, col["min_ip"]], 0.0)
+                    diff = max_c - min_c
+                    norm = jnp.where(
+                        (diff > 0)[:, None],
+                        K._idiv(MAX_NODE_SCORE * (raw - min_c[:, None]),
+                                jnp.maximum(diff, 1.0)[:, None]), 0.0)
+                    s = jnp.where(ipaany_ref[...][:, None], norm, raw)
+                elif name == "PodTopologySpread":
+                    # no-soft-constraints constant path (scoring.go
+                    # maxScore==0): MaxNodeScore on every feasible node
+                    s = jnp.where(f, MAX_NODE_SCORE, 0.0)
+                elif name == "DefaultPodTopologySpread":
+                    raw = planes_ref[plane["dps_raw"]]
+                    zt = zone_tile()
+                    max_node = jnp.maximum(stats[sl, col["max_dps"]], 0.0)
+                    f_score = jnp.where(
+                        (max_node > 0)[:, None],
+                        MAX_NODE_SCORE * (max_node[:, None] - raw)
+                        / jnp.maximum(max_node, 1.0)[:, None],
+                        MAX_NODE_SCORE)
+                    cz = czone[sl, :]
+                    max_zone = jnp.maximum(jnp.max(cz, axis=1), 0.0)
+                    nzc = jnp.dot(cz, zt.T,
+                                  preferred_element_type=jnp.float32)
+                    zone_score = jnp.where(
+                        (max_zone > 0)[:, None],
+                        MAX_NODE_SCORE * (max_zone[:, None] - nzc)
+                        / jnp.maximum(max_zone, 1.0)[:, None],
+                        MAX_NODE_SCORE)
+                    with_zone = (f_score * (1.0 - K.ZONE_WEIGHTING)
+                                 + K.ZONE_WEIGHTING * zone_score)
+                    havez = stats[sl, col["havez"]] > 0
+                    has_zone = jnp.any(zt > 0, axis=1)
+                    out = jnp.where(havez[:, None] & has_zone[None, :],
+                                    with_zone, f_score)
+                    out = jnp.floor(out)
+                    s = jnp.where(skip_ref[...][:, None], 0.0, out)
+                else:  # pragma: no cover - unsupported_reason() gates this
+                    raise ValueError("pallas backend: unsupported score "
+                                     "kernel %s" % name)
+                total = total + jnp.where(f, s, 0.0) * weight
+            if "bias" in plane:
+                total = total + planes_ref[plane["bias"]]
+            masked = jnp.where(f, total, _NEG)
+            tile_best = jnp.max(masked, axis=1)
+            gum = planes_ref[plane["gumbel"]]
+            h = jnp.where((masked == tile_best[:, None]) & f, gum, _NEG)
+            tile_h = jnp.max(h, axis=1)
+            tile_arg = (jnp.argmax(h, axis=1).astype(jnp.int32)
+                        + n * L.TN)
+
+            @pl.when(n == 0)
+            def _():
+                stats[sl, col["best"]] = tile_best
+                stats[sl, col["hh"]] = tile_h
+                idxs[sl] = tile_arg
+
+            @pl.when(n > 0)
+            def _():
+                rb = stats[sl, col["best"]]
+                rh = stats[sl, col["hh"]]
+                ri = idxs[sl]
+                # first-index tie-break: update only on STRICT improvement
+                # (earlier tiles, and jnp.argmax within a tile, keep the
+                # lowest index on exact equality — matching the oracle)
+                upd = tile_best > rb
+                updh = (tile_best == rb) & (tile_h > rh)
+                stats[sl, col["best"]] = jnp.where(upd, tile_best, rb)
+                stats[sl, col["hh"]] = jnp.where(
+                    upd, tile_h, jnp.where(updh, tile_h, rh))
+                idxs[sl] = jnp.where(upd, tile_arg,
+                                     jnp.where(updh, tile_arg, ri))
+
+            @pl.when(n == L.NT - 1)
+            def _():
+                act = stats[sl, col["act"]] > 0
+                best_ref[...] = stats[sl, col["best"]]
+                prop_ref[...] = jnp.where(act, idxs[sl], L.N).astype(
+                    jnp.int32)
+                act_ref[...] = act
+
+    return kernel
+
+
+def propose(bundle: Dict[str, jnp.ndarray], cfg, live: jnp.ndarray,
+            req: jnp.ndarray, nz: jnp.ndarray, ports_used: jnp.ndarray,
+            n_nodes: int, interpret: bool):
+    """One fused propose step -> (prop [W] i32 in [0, N] with N = no-op,
+    active [W] bool, best [W] f32) — bit-identical to the lax round's
+    propose half for supported configurations."""
+    W = int(live.shape[0])
+    N = int(n_nodes)
+    R = int(bundle["alloc"].shape[1])
+    P = int(bundle["bports"].shape[1])
+    Z = int(bundle["zone"].shape[1])
+    has_bias = bundle["planes"].shape[0] == len(plane_order(cfg, True))
+    L = _layout(cfg, has_bias, W, N, R, P, Z)
+    WB = -(-W // L.TB)
+    Wpad = WB * L.TB
+
+    def padw(x, fill=0):
+        if Wpad == x.shape[0]:
+            return x
+        pad = [(0, Wpad - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+        return jnp.pad(x, pad, constant_values=fill)
+
+    def padw1(x, fill=0):  # planes: pad axis 1
+        if Wpad == x.shape[1]:
+            return x
+        return jnp.pad(x, [(0, 0), (0, Wpad - x.shape[1]), (0, 0)],
+                       constant_values=fill)
+
+    S = bundle["planes"].shape[0]
+    kernel = _make_kernel(L)
+    grid = (2, WB, L.NT)
+    prop, best, act = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((S, L.TB, L.TN), lambda p, b, n: (0, b, n)),
+            pl.BlockSpec((L.TB, L.TN), lambda p, b, n: (b, n)),
+            pl.BlockSpec((L.TN, R), lambda p, b, n: (n, 0)),
+            pl.BlockSpec((L.TN, Z), lambda p, b, n: (n, 0)),
+            pl.BlockSpec((L.TN, R), lambda p, b, n: (n, 0)),
+            pl.BlockSpec((L.TN, 2), lambda p, b, n: (n, 0)),
+            pl.BlockSpec((L.TN, P), lambda p, b, n: (n, 0)),
+            pl.BlockSpec((L.TB, R), lambda p, b, n: (b, 0)),
+            pl.BlockSpec((L.TB, 2), lambda p, b, n: (b, 0)),
+            pl.BlockSpec((L.TB, P), lambda p, b, n: (b, 0)),
+            pl.BlockSpec((L.TB,), lambda p, b, n: (b,)),
+            pl.BlockSpec((L.TB,), lambda p, b, n: (b,)),
+            pl.BlockSpec((L.TB,), lambda p, b, n: (b,)),
+        ],
+        out_specs=(
+            pl.BlockSpec((L.TB,), lambda p, b, n: (b,)),
+            pl.BlockSpec((L.TB,), lambda p, b, n: (b,)),
+            pl.BlockSpec((L.TB,), lambda p, b, n: (b,)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((Wpad,), jnp.int32),
+            jax.ShapeDtypeStruct((Wpad,), jnp.float32),
+            jax.ShapeDtypeStruct((Wpad,), jnp.bool_),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((Wpad, L.n_stats), jnp.float32),
+            pltpu.VMEM((Wpad, Z), jnp.float32),
+            pltpu.VMEM((Wpad,), jnp.int32),
+        ],
+        interpret=interpret,
+    )(
+        padw1(bundle["planes"]), padw(bundle["mask"]),
+        bundle["alloc"], bundle["zone"], req, nz, ports_used,
+        padw(bundle["breq"]), padw(bundle["bnz"]), padw(bundle["bports"]),
+        padw(live), padw(bundle["skip"]), padw(bundle["ipa_any"]),
+    )
+    return prop[:W], act[:W], best[:W]

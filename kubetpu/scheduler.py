@@ -915,7 +915,8 @@ class Scheduler:
                     cluster, batch, cfg, self._next_rng(),
                     host_ok=host_ok_dev,
                     intra_batch_topology=needs_topo,
-                    score_bias=prep.score_bias)
+                    score_bias=prep.score_bias,
+                    kernel_backend=self.config.kernel_backend)
             # the auction already produced per-pod verdict rows; share them
             # lazily so preemption can skip its candidates pass without the
             # scheduler paying a multi-MB transfer it may never need
@@ -1002,9 +1003,26 @@ class Scheduler:
         packed = self._readback_group(prep, res)
         with prep.trace.stage("commit"):
             out = self._commit_group(prep, packed)
-        prep.trace.finish()
+        if self.config.mode == "gang":
+            # per-cycle auction rounds as cycle meta: bench aggregates the
+            # histogram across cycles and traceview shows a digest column,
+            # so the round-count reduction ROADMAP item 3 claims is
+            # directly observable per run, not just as a max
+            prep.trace.finish(auction_rounds=self.last_gang_rounds,
+                              kernel_backend=self._gang_backend(prep))
+        else:
+            prep.trace.finish()
         self._sync_flight_dropped()
         return out
+
+    def _gang_backend(self, prep: PreparedCycle) -> str:
+        """The kernel backend this cycle actually traced (pallas falls
+        back per cycle on unsupported routing, e.g. topology batches)."""
+        if self._mesh is not None or self.config.kernel_backend != "pallas":
+            return "lax"
+        from .utils import pallas_backend as PB
+        return PB.effective_backend(prep.cfg, prep.needs_topo, "pallas",
+                                    batch=prep.batch)
 
     def _readback_group(self, prep: PreparedCycle, res) -> np.ndarray:
         """ONE device->host readback per cycle: the packed [3B+1] i32 view
@@ -1042,7 +1060,8 @@ class Scheduler:
             from .utils.flops import gang_cycle_flops
             self.device_flops += gang_cycle_flops(
                 prep.cluster, prep.batch, prep.cfg, self.last_gang_rounds,
-                intra_batch_topology=prep.needs_topo)
+                intra_batch_topology=prep.needs_topo,
+                kernel_backend=self._gang_backend(prep))
         # one .tolist() per field: the commit loop below reads every entry,
         # and plain Python ints beat a numpy scalar box per access at 4k
         # pods/cycle (kubelint host-sync audit)
@@ -1837,6 +1856,16 @@ class Scheduler:
                     from .models.gang import run_auction
                     res = run_auction(cluster, batch, cfg, rng,
                                       score_bias=warm_bias)
+                    if self.config.kernel_backend == "pallas":
+                        # term-free serving batches route
+                        # intra_batch_topology=False + pallas — a DISTINCT
+                        # compiled program; warm it or the first term-free
+                        # cycle pays the megakernel compile stall
+                        res_p = run_auction(cluster, batch, cfg, rng,
+                                            score_bias=warm_bias,
+                                            intra_batch_topology=False,
+                                            kernel_backend="pallas")
+                        np.asarray(res_p.packed)
             elif self._mesh is not None:
                 from .parallel import mesh as pmesh
                 res = pmesh.sharded_schedule_sequential(
@@ -1978,6 +2007,12 @@ class Scheduler:
         res = run_auction(cluster, batch, cfg, rng,
                           score_bias=warm_bias)
         np.asarray(res.packed)
+        if self.config.kernel_backend == "pallas":
+            res_p = run_auction(cluster, batch, cfg, rng,
+                                score_bias=warm_bias,
+                                intra_batch_topology=False,
+                                kernel_backend="pallas")
+            np.asarray(res_p.packed)
         if self.decisions.enabled:
             # audit program per pod-axis bucket, like the auction (a
             # drain's failures can land in any grown bucket); both

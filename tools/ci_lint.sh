@@ -36,3 +36,13 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m tools.kubecensus --check --json
 # manifest row, or a manifest row with no artifact at census rungs,
 # fails.  Regenerate after an intentional surface change: make aot.
 python -m tools.kubeaot --check --json
+# Pallas megakernel bit-match oracle (ops/pallas_kernels.py): the
+# interpret-mode differential suite on CPU — lax vs pallas GangResults
+# must be bit-identical on randomized churned clusters, the committed
+# golden worlds, and the fallback routings.  Also covers the two new
+# kubelint pallas checks (recompile/pallas-dynamic-grid,
+# purity/pallas-host-callback) via tests/test_kubelint.py above.
+# Environments without jax.experimental.pallas degrade to a REASONED
+# pytest skip (the suite's module-level skipif), never a failure.
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
+	tests/test_pallas_gang.py -q -m 'not slow' -p no:cacheprovider

@@ -311,6 +311,26 @@ def _schedule_gang_bias(w):
             {"host_ok": w.host_ok(), "score_bias": w.score_bias()})
 
 
+def _schedule_gang_pallas(w):
+    from kubetpu.models import gang
+    # the fused-megakernel serving call form: a TERM-FREE batch routes
+    # intra_batch_topology=False + kernel_backend="pallas" (scheduler's
+    # needs_topo gate); on CPU the pallas_call lowers under interpret=True
+    # — a DIFFERENT program (and AOT key) from a Mosaic lowering, which is
+    # exactly why the backend is a static arg
+    return (gang._schedule_gang, (w.cluster, w.batch, w.cfg, w.rng),
+            {"intra_batch_topology": False, "kernel_backend": "pallas"})
+
+
+def _schedule_gang_pallas_hostok(w):
+    from kubetpu.models import gang
+    # host-filter cycles (volume pods are term-free, so they still route
+    # to the megakernel) pass host_ok as KEYWORD like the serving seam
+    return (gang._schedule_gang, (w.cluster, w.batch, w.cfg, w.rng),
+            {"host_ok": w.host_ok(), "intra_batch_topology": False,
+             "kernel_backend": "pallas"})
+
+
 def _seq_cfg(w):
     # the serving loop passes 0 (= the reference's ADAPTIVE default,
     # types.go:251) unless a profile pins a percentage; the adaptive
@@ -465,6 +485,13 @@ ENTRIES: List[Entry] = [
           _schedule_gang_hostok, tag="hostok", static_argnums=(2,)),
     Entry("_schedule_gang", "kubetpu.models.gang:_schedule_gang",
           _schedule_gang_bias, tag="bias", static_argnums=(2,)),
+    Entry("_schedule_gang", "kubetpu.models.gang:_schedule_gang",
+          _schedule_gang_pallas, tag="pallas", static_argnums=(2,),
+          static_argnames=("intra_batch_topology", "kernel_backend")),
+    Entry("_schedule_gang", "kubetpu.models.gang:_schedule_gang",
+          _schedule_gang_pallas_hostok, tag="pallas_hostok",
+          static_argnums=(2,),
+          static_argnames=("intra_batch_topology", "kernel_backend")),
     Entry("_schedule_sequential",
           "kubetpu.models.sequential:_schedule_sequential",
           _schedule_sequential, meshable=True, static_argnums=(2,)),

@@ -119,6 +119,35 @@ def delta_summary(spans: List[dict]) -> str:
             f"(rows p50 {p50}), {resyncs} resyncs")
 
 
+def auction_summary(doc) -> str:
+    """One-line auction digest under the stage table: the per-cycle round
+    HISTOGRAM (rounds -> cycles) plus the kernel-backend split, read from
+    cycle meta (Scheduler records auction_rounds/kernel_backend on every
+    gang cycle).  Makes the round-count reduction ROADMAP item 3 claims
+    directly visible in `make trace` output."""
+    metas = []
+    if isinstance(doc.get("cycle_meta"), list):        # pipeline doc
+        metas = [c.get("meta", {}) for c in doc["cycle_meta"]]
+    elif isinstance(doc.get("cycles"), list):          # flightz dump
+        metas = [c.get("meta", {}) for c in doc["cycles"]]
+    rounds = [m["auction_rounds"] for m in metas
+              if isinstance(m.get("auction_rounds"), int)]
+    if not rounds:
+        return ""
+    hist: Dict[int, int] = {}
+    for r in rounds:
+        hist[r] = hist.get(r, 0) + 1
+    backends: Dict[str, int] = {}
+    for m in metas:
+        kb = m.get("kernel_backend")
+        if kb:
+            backends[kb] = backends.get(kb, 0) + 1
+    h = " ".join(f"{r}r:{n}" for r, n in sorted(hist.items()))
+    b = " ".join(f"{k}:{n}" for k, n in sorted(backends.items()))
+    return (f"auction rounds: {h} (max {max(rounds)}"
+            + (f"; backend {b}" if b else "") + ")")
+
+
 def cycle_tree(spans: List[dict], cycle: int,
                threshold_ms: float = 0.0) -> str:
     cs = [s for s in spans if s["cycle"] == cycle]
@@ -167,6 +196,9 @@ def main(argv=None) -> int:
         doc = json.load(f)
     spans = _load_spans(doc)
     print(flame_summary(spans))
+    auction = auction_summary(doc)
+    if auction:
+        print(auction)
     if not spans:
         return 0
     wall: Dict[int, float] = {}
