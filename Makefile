@@ -3,7 +3,8 @@
 # lint gate via tests/test_kubelint.py).  `make help` lists everything.
 
 .PHONY: help lint lock-graph test sanitize-test race-test flight-test \
-	delta-test census census-test aot aot-test pallas-test trace bench
+	delta-test census census-test aot aot-test pallas-test chaos-test \
+	trace bench
 
 help:
 	@echo "kubetpu targets:"
@@ -43,6 +44,11 @@ help:
 	@echo "                      (randomized churned clusters + goldens +"
 	@echo "                      compile-once watchdog); reasoned skip when"
 	@echo "                      pallas is unavailable"
+	@echo "  make chaos-test     chaos harness + self-healing runtime suite:"
+	@echo "                      seeded fault injection (dispatch, delta"
+	@echo "                      scatter, aot load, bind/extender/watch"
+	@echo "                      transport), deadline demotion, anti-entropy"
+	@echo "                      verifier, disarmed-no-op poison test"
 	@echo "  make trace          run the pipelined drain with the flight"
 	@echo "                      recorder armed, write PIPELINE_TRACE.json +"
 	@echo "                      .perfetto.json, print the text flame summary"
@@ -113,6 +119,14 @@ aot-test:
 pallas-test:
 	JAX_PLATFORMS=cpu python -m pytest \
 		tests/test_pallas_gang.py -q -m 'not slow' -p no:cacheprovider
+
+# chaos harness (kubetpu/utils/chaos.py): every named injection point's
+# seeded recovery-invariant scenario — no lost pods, no double binds,
+# mirror/device bit-consistency after induced faults — plus the
+# disarmed-hot-path poison test
+chaos-test:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_chaos.py -q -m 'not slow' -p no:cacheprovider
 
 # pipelined-drain trace via the flight recorder + text flame summary
 # (PIPELINE_TRACE.json + PIPELINE_TRACE.perfetto.json for ui.perfetto.dev)

@@ -346,6 +346,9 @@ def gate_entries(detail):
     cd = detail.get("chain_drain", {})
     for name in ("pipelined", "chain_on", "chain_off", "delta_sparse"):
         entry(f"chain_drain.{name}.pods_per_sec", cd.get(name))
+    # node-flap storm throughput floor (the case has no warm repeat, so
+    # the generous default min_frac from an empty spread applies)
+    entry("node_flap.pods_per_sec", detail.get("node_flap"))
     # cold_restart_s CEILING (lower is better, unlike the throughput
     # floors): restart-to-first-placement with AOT artifacts shipped.
     # The failure mode this catches is categorical — artifacts stop
@@ -529,6 +532,78 @@ def pv_heavy_case(n_nodes=1000, n_pods=2048):
             }
     stats["repeat_raw_s"] = raw_s
     stats["spread"] = _spread(raw_s[1:])
+    sched.close()
+    return stats
+
+
+def node_flap_case(n_nodes=256, n_pods=1024, waves=4, flap=24):
+    """Node-flap churn storm (ROADMAP item 5): between pod waves, `flap`
+    nodes are deleted and re-added — the autoscaler add/remove pattern —
+    so every wave's first cycle hits the DeltaTensorizer's node-set
+    resync path while the drain keeps placing pods.  chain OFF so each
+    cycle exercises the delta/resync machinery rather than the gang
+    chain.  The schema carries resync_count + delta telemetry under the
+    BENCH_GATE=1 drift gate: a recovery-path regression (resyncs
+    exploding, or the storm cratering throughput) fails the run like any
+    other floor."""
+    import random
+
+    from kubetpu.apis.config import (KubeSchedulerConfiguration,
+                                     KubeSchedulerProfile)
+    from kubetpu.client.store import ClusterStore
+    from kubetpu.harness import hollow
+    from kubetpu.scheduler import Scheduler
+
+    rng = random.Random(0)
+    store = ClusterStore()
+    nodes = hollow.make_nodes(n_nodes, zones=8)
+    for n in nodes:
+        store.add(n)
+    cfg = KubeSchedulerConfiguration(
+        profiles=[KubeSchedulerProfile()],
+        batch_size=max(64, n_pods // waves), mode="gang",
+        chain_cycles=False)
+    sched = Scheduler(store, config=cfg, async_binding=False)
+    sched.device_wait_s = 0.0
+    outcomes = []
+    cycle_times = []
+    t0 = time.time()
+    for wave in range(waves):
+        for p in hollow.make_pods(n_pods // waves,
+                                  prefix=f"flap-{wave}-"):
+            store.add(p)
+        while True:
+            tc = time.time()
+            got = sched.schedule_pending(timeout=0.2)
+            if not got:
+                break
+            cycle_times.append(time.time() - tc)
+            outcomes.extend(got)
+        # the storm: rip `flap` random nodes out and bring them back —
+        # bound pods ride through (the cache keeps their NodeInfo), and
+        # the changed node set forces the blessed full resync
+        victims = rng.sample(nodes, flap)
+        for n in victims:
+            store.delete(n)
+        for n in victims:
+            store.add(n)
+    dt = time.time() - t0
+    scheduled = sum(1 for o in outcomes if o.node)
+    stats = {
+        "nodes": n_nodes, "pods": len(outcomes), "waves": waves,
+        "flap_per_wave": flap,
+        "e2e_s": round(dt, 3),
+        "cycles": len(cycle_times),
+        "cycle_p50_s": round(_percentile(cycle_times, 0.5), 3),
+        "cycle_p99_s": round(_percentile(cycle_times, 0.99), 3),
+        "device_wait_s": round(sched.device_wait_s, 3),
+        "scheduled": scheduled,
+        "pods_per_sec": round(len(outcomes) / max(dt, 1e-9), 1),
+        # the recovery-path telemetry this case exists to record
+        "resync_count": sched.resync_count,
+        "delta_rows_p50": _median(list(sched.delta_rows)),
+        "recoveries": len(sched.recovery_log),
+    }
     sched.close()
     return stats
 
@@ -981,6 +1056,12 @@ def main() -> None:
             detail["preemption"] = preemption_case()
         except Exception as e:  # pragma: no cover - depends on device state
             detail["preemption"] = {"error": repr(e)}
+
+    if os.environ.get("BENCH_NODE_FLAP", "1") == "1" and mesh_shape is None:
+        try:
+            detail["node_flap"] = node_flap_case()
+        except Exception as e:  # pragma: no cover - depends on device state
+            detail["node_flap"] = {"error": repr(e)}
 
     if (os.environ.get("BENCH_BACKENDS", "1") == "1"
             and mesh_shape is None):

@@ -114,6 +114,27 @@ class KubeSchedulerConfiguration:
     #            anything else falls back to lax with a recorded reason,
     #            and placements are bit-identical either way.
     kernel_backend: str = "lax"
+    # Deadline-guarded dispatch (the self-healing runtime): a cycle whose
+    # device dispatch errors — or whose dispatch-to-readback wall time
+    # exceeds this deadline — is DISCARDED before anything commits: the
+    # backend is demoted one rung (pallas -> lax, AOT artifacts ->
+    # trace) with a recorded reason, the device residents are
+    # invalidated (next cycle resyncs from the host mirror), and the
+    # cycle's pods are requeued through the backoff queue — never lost,
+    # never double-bound.  0 (default) disables the deadline; dispatch
+    # ERRORS are always recovered.  Env override: KUBETPU_DISPATCH_DEADLINE.
+    dispatch_deadline_seconds: float = 0.0
+    # Transient bind failures (DefaultBinder's transport-exception path)
+    # retry this many times before the pod is marked failed, sleeping the
+    # pod backoff ladder between attempts (pod_initial_backoff_seconds
+    # doubling, capped at pod_max_backoff_seconds) — a once-flaky API
+    # server must not cost a placement the cycle already won.  Each retry
+    # first checks whether the bind landed server-side (bind is not
+    # idempotent; a lost response must not re-POST into a Conflict).
+    # Retries run on whichever thread ran bind: the binder pool under
+    # async binding (the default), the serving loop under sync binding —
+    # where each failing pod can stall it for the summed backoff.
+    bind_retries: int = 2
     mesh_shape: Optional[tuple] = None
     # Cycle chaining (gang mode): reuse the auction's materialized cluster
     # as the next cycle's snapshot tensors instead of re-tensorizing

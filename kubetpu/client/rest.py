@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import collections
 import json
+import random
 import threading
 import urllib.error
 import urllib.request
@@ -26,10 +27,16 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..api import types as api
+from ..utils import chaos
 from . import codec
 from .store import ClusterStore, Conflict, NotFound
 
 WATCH_BUFFER = 16384
+# reconnect backoff for the watch loop (reflector.go's wait.Backoff
+# shape): exponential from INITIAL, capped, with jitter — a dead or
+# flapping API server must cost sleeps, not a spinning core
+WATCH_BACKOFF_INITIAL = 0.2
+WATCH_BACKOFF_CAP = 5.0
 
 
 class APIServer:
@@ -235,6 +242,16 @@ class RestClusterStore(ClusterStore):
         self.base_url = base_url.rstrip("/")
         self._stop = threading.Event()
         self._synced = threading.Event()
+        # reconnect accounting (watch thread only): total backoff sleeps
+        # taken and the last computed delay — the dead-server test
+        # asserts the attempt count stays bounded and the delay grows.
+        # The jitter rng is entropy-seeded PER INSTANCE: a shared fixed
+        # seed would make every reflector in a fleet draw identical
+        # jitter and reconnect in lockstep — the herd the jitter exists
+        # to break up
+        self._watch_retries = 0
+        self._watch_backoff_s = 0.0
+        self._backoff_rng = random.Random()
         self._watch_thread = threading.Thread(target=self._watch_loop,
                                               daemon=True)
         self._watch_thread.start()
@@ -242,6 +259,9 @@ class RestClusterStore(ClusterStore):
     # -- transport ----------------------------------------------------------
 
     def _req(self, method: str, path: str, doc=None, timeout=30.0):
+        # chaos seam (utils/chaos.py "rest"): a transient API-server
+        # transport error, surfaced exactly where a socket error would be
+        chaos.raise_or_stall("rest")
         data = json.dumps(doc).encode() if doc is not None else None
         req = urllib.request.Request(
             self.base_url + path, data=data, method=method,
@@ -319,25 +339,46 @@ class RestClusterStore(ClusterStore):
                     self._apply_obj(kind, "delete", old, None)
         return min(seqs, default=0)
 
+    def _next_backoff(self, failures: int) -> float:
+        """Capped exponential backoff with jitter for the reconnect loop
+        (reference: reflector.go's wait.Backoff).  failures is the
+        CONSECUTIVE failure count; jitter is a uniform [0.5, 1.0) factor
+        so a fleet of reflectors does not reconnect in lockstep."""
+        self._watch_retries += 1
+        base = min(WATCH_BACKOFF_CAP,
+                   WATCH_BACKOFF_INITIAL * (2 ** min(failures - 1, 16)))
+        delay = base * (0.5 + 0.5 * self._backoff_rng.random())
+        self._watch_backoff_s = delay
+        return delay
+
     def _watch_loop(self) -> None:
         seq = None
+        failures = 0
         while not self._stop.is_set():
             if seq is None:
                 seq = self._list_all()
                 if seq is None:
-                    if self._stop.wait(0.5):
+                    failures += 1
+                    if self._stop.wait(self._next_backoff(failures)):
                         return
                     continue
+                failures = 0
                 self._synced.set()
             try:
+                # chaos seam (utils/chaos.py "watch"): a dropped watch
+                # connection, recovered by the same backoff ladder a real
+                # transport error takes
+                chaos.raise_or_stall("watch")
                 # client bound = server hold (10 s) + slack, so close()'s
                 # join bound below really does cover one poll round trip
                 doc = self._req("GET", f"/watch?since={seq}&timeout=10",
                                 timeout=12.0)
             except Exception:  # noqa: BLE001 — retry after transport error
-                if self._stop.wait(0.5):
+                failures += 1
+                if self._stop.wait(self._next_backoff(failures)):
                     return
                 continue
+            failures = 0
             # buffer eviction check ("resourceVersion too old"): events
             # older than ours were dropped before we read them -> RELIST
             oldest = int(doc.get("oldest", 0))

@@ -16,6 +16,7 @@ import urllib.request
 from typing import Dict, List, Optional, Tuple
 
 from .api import types as api
+from .utils import chaos
 
 MAX_EXTENDER_PRIORITY = 10  # reference: extender/v1/types.go:109
 DEFAULT_EXTENDER_TIMEOUT = 5.0
@@ -57,6 +58,10 @@ class HTTPExtender:
 
     def _send(self, verb: str, args: Dict) -> Dict:
         # reference: extender.go:412 send
+        # chaos seam (utils/chaos.py "extender"): a transient webhook
+        # transport error — flows through each verb's existing
+        # ignorable/ExtenderError handling, never a new failure class
+        chaos.raise_or_stall("extender")
         url = f"{self.url_prefix}/{verb}"
         data = json.dumps(args).encode()
         req = urllib.request.Request(

@@ -18,6 +18,7 @@ from ..api import types as api
 from ..framework import interface as fw
 from ..framework.interface import Status, TensorPlugin
 from ..ops import kernels as K
+from ..utils import chaos
 
 
 class PrioritySort(fw.QueueSortPlugin):
@@ -347,6 +348,10 @@ class DefaultBinder(fw.BindPlugin):
         if self.client is None:
             return Status.error("DefaultBinder: no client configured")
         try:
+            # chaos seam (utils/chaos.py "bind"): a transient binding
+            # transport error, caught below like any real one — the
+            # scheduler's bind retry ladder is what recovers it
+            chaos.raise_or_stall("bind")
             self.client.bind(pod, node_name)
         except Exception as e:  # bind failures feed the Forget/requeue path
             return Status.error(f"binding rejected: {e}")

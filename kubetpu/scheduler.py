@@ -45,6 +45,7 @@ from .schedqueue.queue import SchedulingQueue
 from .state.cache import SchedulerCache, Snapshot
 from .state.delta import DeltaTensorizer
 from .state.tensors import SnapshotBuilder
+from .utils import chaos as uchaos
 from .utils import trace as utrace
 from .utils.decisions import DecisionLog, PodDecision
 from .utils.trace import Trace
@@ -92,6 +93,23 @@ class PreparedCycle:
     # per-pod host-filter rejection reasons (uid -> reason -> node count),
     # folded into the DecisionLog by the commit-path audit
     host_reject: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    # wall-clock of the device dispatch start — the deadline guard
+    # measures dispatch-to-readback against it (0.0 = never dispatched)
+    dispatch_t0: float = 0.0
+    # CompileTimer snapshot taken at dispatch_t0 (deadline armed only):
+    # a cycle with any compile/cache-load activity is exempt from the
+    # deadline, so a first-compile of a new pod bucket — legitimate,
+    # bounded work — can never trip it and demote a healthy backend
+    compile_snap: Optional[dict] = None
+    # host-side seconds spent inside this cycle's dispatch->readback
+    # window on OTHER work (the pipelined drain runs k-1's commit loop
+    # there) — subtracted before the deadline comparison
+    host_exempt_s: float = 0.0
+    # wall-clock when this cycle was parked in _inflight_cycle: caller
+    # think time between schedule_pending calls is host time too, and
+    # must not count against the dispatch deadline (a device hang still
+    # counts — it blocks the READBACK, which runs after pickup)
+    parked_t: float = 0.0
 
 
 class Scheduler:
@@ -110,6 +128,9 @@ class Scheduler:
         # back silently on env mismatch — the trace path always works)
         from .utils import aot as _aot
         _aot.maybe_arm_from_env()
+        # KUBETPU_CHAOS: arm the fault-injection registry (utils/chaos.py);
+        # disarmed (the default) every injection site is one attribute read
+        uchaos.maybe_arm_from_env()
         import jax
         self.store = store
         self.config = config or KubeSchedulerConfiguration(
@@ -213,6 +234,28 @@ class Scheduler:
         self.delta_rows = deque(maxlen=4096)
         self.delta_cycle_count = 0
         self.resync_count = 0
+        # self-healing runtime: the dispatch deadline (0 = off; env
+        # overrides config so an operator can arm it on a live fleet),
+        # the recovery audit trail (serving thread only, like
+        # _audit_cache), and the chaos fire counts already folded into
+        # scheduler_faults_injected_total
+        import os as _os
+        _dl = _os.environ.get("KUBETPU_DISPATCH_DEADLINE")
+        self._dispatch_deadline = (
+            float(_dl) if _dl
+            else float(getattr(self.config, "dispatch_deadline_seconds",
+                               0.0) or 0.0))
+        # bounded like delta_rows: a persistent fault must not grow a
+        # serving daemon's memory one incident dict per cycle forever
+        self.recovery_log: deque = deque(maxlen=256)
+        self._chaos_seen: Dict[str, int] = {}
+        # deadline grace: cycles exempt from the deadline right after a
+        # recovery — the recovery itself invalidates residents and can
+        # change the traced program (demotion, new pod bucket), so the
+        # next dispatch legitimately pays resync/compile cost; without
+        # the grace a recovery could trip the deadline it just served
+        # and requeue forever (serving thread only)
+        self._deadline_grace = 0
         # pipelined drain: the dispatched-but-uncommitted cycle (prep, res)
         self._inflight_cycle = None
         # (pod-axis bucket, compile-or-load seconds) per prewarmed program
@@ -471,16 +514,54 @@ class Scheduler:
                     return returned + early
             # readback k-1 BEFORE dispatching k (FIFO tunnel), then
             # dispatch k, then run k-1's commit loop while k executes
-            packed_prev = self._readback_group(*prev) if prev else None
+            packed_prev = None
+            if prev is not None:
+                packed_prev, rec_prev = self._readback_guarded(*prev)
+                if rec_prev is not None:
+                    # k-1's dispatch errored or blew its deadline: it was
+                    # recovered (pods requeued, residents invalidated) —
+                    # and k, prepared against its chain/residents, must
+                    # be discarded and re-prepared from a fresh snapshot
+                    prev = None
+                    stale = prep.trace
+                    prep, early2 = self._prepare_group(
+                        fwk, prep.live, relevance=relevance)
+                    stale.finish(discarded=True)
+                    early += rec_prev + early2
+                    if prep is None:
+                        return returned + early
+            res = None
             with prep.trace.stage("dispatch",
                                   pipelined=prev is not None):
-                res = self._dispatch_group(
-                    prep, extra_uncommitted=(prev[0].batch.valid.shape[0]
-                                             if prev else 0))
+                try:
+                    res = self._dispatch_group(
+                        prep,
+                        extra_uncommitted=(prev[0].batch.valid.shape[0]
+                                           if prev else 0))
+                except Exception as e:  # device fault at the dispatch
+                    # seam: recover k (requeue), still commit k-1 below
+                    early += self._recover_cycle(prep, repr(e),
+                                                 "dispatch-error")
+            if res is None:
+                prep.trace.finish(recovered="dispatch-error")
+                outcomes = []
+                if prev is not None:
+                    with prev[0].trace.stage("commit"):
+                        outcomes = self._commit_group(prev[0], packed_prev)
+                    prev[0].trace.finish()
+                self._sync_flight_dropped()
+                return returned + outcomes + early
             self._last_commit_failed = False
             if prev is not None:
+                # k-1's commit loop runs on the serving thread while k
+                # executes on device; its wall time (incl. sync-binding
+                # retry sleeps) lands between k's dispatch and readback,
+                # so it is EXEMPT from k's dispatch deadline — host-side
+                # commit cost must never demote a healthy device
+                t_commit = time.time()
                 with prev[0].trace.stage("commit"):
                     outcomes = self._commit_group(prev[0], packed_prev)
+                prep.host_exempt_s += time.time() - t_commit
                 prev[0].trace.finish()
                 self._sync_flight_dropped()
             else:
@@ -498,7 +579,14 @@ class Scheduler:
                 if prep is None:
                     return returned + outcomes + early
                 with prep.trace.stage("dispatch"):
-                    res = self._dispatch_group(prep)
+                    try:
+                        res = self._dispatch_group(prep)
+                    except Exception as e:
+                        early += self._recover_cycle(prep, repr(e),
+                                                     "dispatch-error")
+                        prep.trace.finish(recovered="dispatch-error")
+                        return returned + outcomes + early
+            prep.parked_t = time.time()
             self._inflight_cycle = (prep, res)
             returned += outcomes + early
             if returned:
@@ -557,7 +645,14 @@ class Scheduler:
             finally:
                 prep.trace.finish()
         with prep.trace.stage("dispatch"):
-            res = self._dispatch_group(prep)
+            try:
+                res = self._dispatch_group(prep)
+            except Exception as e:  # device/backend fault: recover, never
+                # lose the batch (the old behavior leaked the popped pods
+                # when the serving loop swallowed the exception)
+                out = self._recover_cycle(prep, repr(e), "dispatch-error")
+                prep.trace.finish(recovered="dispatch-error")
+                return outcomes + out
         return outcomes + self._finish_group(prep, res)
 
     @staticmethod
@@ -709,6 +804,16 @@ class Scheduler:
                               reason=dstats.reason)
             if dstats.resync:
                 self.resync_count += 1
+                if dstats.reason == "verify-divergence":
+                    # the anti-entropy verifier caught device residents
+                    # diverging from the host mirror and forced the
+                    # targeted full resync — a recovery, not churn
+                    self.recovery_log.append(
+                        {"kind": "verify-resync",
+                         "reason": dstats.reason,
+                         "cycle": self.cycle_count})
+                    if self.metrics is not None:
+                        self.metrics.recoveries.inc("verify-resync")
             elif dstats.delta_rows > 0:
                 # zero-dirty cycles (retry churn with no cache events) ran
                 # no scatter — counting them would drag the row p50 to 0
@@ -899,6 +1004,17 @@ class Scheduler:
                                     prep.cfg)
         host_ok_dev, cycle_ctx = prep.host_ok_dev, prep.cycle_ctx
         n_nodes = len(prep.node_infos)
+        # deadline-guard anchor + chaos seam (utils/chaos.py "dispatch"):
+        # an injected error models the device dying under the program; an
+        # injected stall models a hung tunnel — both recovered by
+        # _recover_cycle via the guarded call sites / readback
+        prep.dispatch_t0 = time.time()
+        if self._dispatch_deadline > 0:
+            # idempotent singleton; first call installs the
+            # jax.monitoring listener, later calls are a lock + read
+            from .utils.sanitize import install_compile_timer
+            prep.compile_snap = install_compile_timer().snapshot()
+        uchaos.raise_or_stall("dispatch")
         # ---- device: one program for the whole group (scan or auction)
         if self.config.mode == "gang":
             needs_topo = prep.needs_topo
@@ -997,10 +1113,140 @@ class Scheduler:
                 self._chain = None
         return res
 
+    # ----------------------------------------------------------- recovery
+
+    def _recover_cycle(self, prep: PreparedCycle, reason: str,
+                       kind: str) -> List[ScheduleOutcome]:
+        """Self-healing path for a cycle whose device dispatch errored or
+        blew its deadline (kind: "dispatch-error" / "dispatch-deadline").
+        Three moves, in order:
+
+        1. DEMOTE the backend one rung with the reason recorded: a
+           pallas-backed profile drops to the lax oracle path
+           (utils/pallas_backend.demote — process-wide, every later
+           cycle routes lax), and an armed AOT runtime disarms
+           (AOT -> trace; the persistent-cache/trace ladder still
+           serves).  The demotion is an incident INSTANT on the cycle's
+           flight record, visible in /debug/flightz and traceview.
+        2. INVALIDATE the device residents this dispatch may have
+           poisoned: the speculative chain and the profile's
+           DeltaTensorizer cluster — the next cycle resyncs from a fresh
+           host walk (the blessed "initial" path).
+        3. REQUEUE the cycle's pods through the backoff queue.  Recovery
+           runs strictly BEFORE the commit loop, so nothing was
+           reserved, assumed or bound: pods are never lost and never
+           double-bound — they simply retry against the demoted backend.
+
+        Never raises: the serving loop must survive any fault this
+        handles."""
+        import logging
+        logging.getLogger("kubetpu").warning(
+            "cycle recovery (%s): %s; %d pods requeued", kind, reason,
+            len(prep.live))
+        # demote ONE rung per fault, outermost first (the ladder the
+        # docstring and README describe): a pallas-backed profile drops
+        # to lax; only a fault that recurs on the lax path disarms AOT.
+        # Demoting everything at once would throw away both fast paths —
+        # and the evidence of which layer actually faulted — on the
+        # first blip.
+        demoted = []
+        if self.config.kernel_backend == "pallas":
+            from .utils import pallas_backend as PB
+            if PB.demotion() is None:
+                PB.demote("%s: %s" % (kind, reason[:200]))
+                demoted.append("pallas->lax")
+        if not demoted:
+            from .utils import aot as _aot
+            if _aot.active_runtime() is not None:
+                _aot.disarm(reason="%s: %s" % (kind, reason[:200]))
+                demoted.append("aot->trace")
+        with self._chain_lock:
+            self._chain = None
+            self._chain_seq += 1
+        self._delta.pop(prep.fwk.profile_name, None)
+        for qp in prep.live:
+            try:
+                self.queue.add_unschedulable_if_not_present(
+                    qp, qp.scheduling_cycle)
+            except ValueError:
+                pass
+        # unschedulable -> backoff/active now (per-pod backoff paces the
+        # retry); without the move the pods would wait for the periodic
+        # leftover flush
+        self.queue.move_all_to_active_or_backoff_queue("DispatchRecovery")
+        self._deadline_grace = 2
+        self.recovery_log.append(
+            {"kind": kind, "reason": reason, "pods": len(prep.live),
+             "demoted": demoted, "cycle": self.cycle_count})
+        if self.metrics is not None:
+            self.metrics.recoveries.inc(kind)
+        if prep.trace.rec is not None:
+            prep.trace.rec.event(
+                "backend-demotion" if demoted else "dispatch-recovery",
+                kind=kind, reason=reason[:256],
+                demoted=",".join(demoted))
+        err = f"dispatch recovered ({kind}): pod requeued"
+        return [ScheduleOutcome(pod=qp.pod, node="", err=err)
+                for qp in prep.live]
+
+    def _readback_guarded(self, prep: PreparedCycle, res):
+        """(packed, None) on success; (None, recovery outcomes) when the
+        readback raised — async dispatch errors surface HERE, at the
+        cycle's only device sync — or when dispatch-to-readback wall
+        time exceeded the configured deadline.  Either way the cycle is
+        discarded pre-commit and recovered (_recover_cycle)."""
+        if prep.parked_t:
+            # time parked in _inflight_cycle = caller think time between
+            # schedule_pending calls — exempt from the deadline
+            prep.host_exempt_s += time.time() - prep.parked_t
+            prep.parked_t = 0.0
+        try:
+            packed = self._readback_group(prep, res)
+        except Exception as e:
+            out = self._recover_cycle(prep, repr(e), "dispatch-error")
+            prep.trace.finish(recovered="dispatch-error")
+            return None, out
+        dl = self._dispatch_deadline
+        if dl > 0 and prep.dispatch_t0:
+            if self._deadline_grace > 0:
+                self._deadline_grace -= 1
+            else:
+                elapsed = (time.time() - prep.dispatch_t0
+                           - prep.host_exempt_s)
+                compiled = False
+                if prep.compile_snap is not None:
+                    # a cycle that paid ANY XLA compile or cache load is
+                    # exempt wholesale: the deadline gates steady-state
+                    # DEVICE health, and demoting a backend over a
+                    # legitimate first-compile would latch the whole
+                    # process off its fast paths.  (Tracing/lowering
+                    # time has no jax.monitoring event, so subtracting
+                    # measured seconds under-exempts — the any-activity
+                    # check is the robust form.  A device hang on a
+                    # compile cycle is caught one cycle later.)
+                    from .utils.sanitize import install_compile_timer
+                    d = install_compile_timer().snapshot()
+                    compiled = any(d[k] != prep.compile_snap[k]
+                                   for k in d)
+                if not compiled and elapsed > dl:
+                    out = self._recover_cycle(
+                        prep, "dispatch+readback %.3fs > deadline %.3fs"
+                        % (elapsed, dl), "dispatch-deadline")
+                    prep.trace.finish(recovered="dispatch-deadline")
+                    return None, out
+        return packed, None
+
     def _finish_group(self, prep: PreparedCycle, res) -> List[ScheduleOutcome]:
         """Readback + commit half of a cycle.  The packed readback is the
         cycle's ONLY device->host sync point."""
-        packed = self._readback_group(prep, res)
+        packed, recovered = self._readback_guarded(prep, res)
+        if packed is None:
+            # the cycle never happened as far as state goes: its pods are
+            # requeued and its residents invalidated; a later pipelined
+            # cycle dispatched against its chain must also re-run
+            self._last_commit_failed = True
+            self._sync_flight_dropped()
+            return recovered
         with prep.trace.stage("commit"):
             out = self._commit_group(prep, packed)
         if self.config.mode == "gang":
@@ -1178,10 +1424,24 @@ class Scheduler:
         trace.log_if_long()
         return outcomes
 
+    def _sync_chaos_metrics(self) -> None:
+        """Fold the armed chaos registry's fire counts into
+        scheduler_faults_injected_total (serving thread only, like
+        _sync_flight_dropped); disarmed this is one attribute read."""
+        reg = uchaos.active()
+        if reg is None or self.metrics is None:
+            return
+        for point, n in reg.counts().items():
+            seen = self._chaos_seen.get(point, 0)
+            if n > seen:
+                self.metrics.faults_injected.inc(point, amount=n - seen)
+                self._chaos_seen[point] = n
+
     def _sync_flight_dropped(self) -> None:
         """Fold new flight-recorder ring drops into the monotonic metric
         counter — called right after each cycle record commits (serving
         thread only, so the seen-count needs no lock)."""
+        self._sync_chaos_metrics()
         fr = utrace.flight_recorder()
         if fr is None or self.metrics is None:
             return
@@ -1527,6 +1787,18 @@ class Scheduler:
         return self._bind_cycle_inner(fwk, qp, state, assumed, node_name,
                                       binder_override)
 
+    def _bound_node(self, pod: api.Pod):
+        """The API's current view of a pod's binding: the node name,
+        "" when the pod exists unbound, None when the pod is gone (or
+        the store is unreadable — the ladder treats unknown as gone and
+        stops; the pod's failure path requeues it anyway).  Best-effort:
+        a REST mirror that lags just defers the verdict one attempt."""
+        try:
+            cur = self.store.get_pod(pod.namespace, pod.metadata.name)
+        except Exception:
+            return None
+        return None if cur is None else (cur.spec.node_name or "")
+
     def _bind_cycle_inner(self, fwk: Framework, qp: QueuedPodInfo,
                           state: CycleState, assumed: api.Pod,
                           node_name: str,
@@ -1554,6 +1826,50 @@ class Scheduler:
                 st = Status.error(f"extender bind failed: {e}")
         else:
             st = fwk.run_bind_plugins(state, pod, node_name)
+            # transient-bind retry ladder: a bind transport ERROR (socket
+            # hiccup, injected chaos "bind" fault) retries in place on
+            # the thread that ran bind (the binder pool under async
+            # binding, the serving loop otherwise), sleeping the pod
+            # backoff ladder between attempts (pod_initial_backoff_seconds
+            # doubling, capped) — the cycle already won this placement; a
+            # once-flaky API server must not cost it.  Each attempt is
+            # gated on the API's CURRENT state, never on error-message
+            # classification: bind is NOT idempotent (BindingREST rejects
+            # any re-bind, even to the same node), so a bind that LANDED
+            # with a lost response resolves to success without a re-POST,
+            # and a pod that is gone or bound elsewhere stops the ladder
+            # immediately — deterministic failures never sleep it.  Only
+            # DefaultBinder's exception path ("binding rejected: ...")
+            # enters at all; config errors fail as before.
+            retries = max(int(getattr(self.config, "bind_retries", 0)), 0)
+            delay = min(self.config.pod_initial_backoff_seconds,
+                        self.config.pod_max_backoff_seconds)
+            attempt = 0
+            while (not st.is_success() and attempt < retries
+                   and st.message().startswith("binding rejected:")):
+                bound = self._bound_node(pod)
+                if bound == node_name:
+                    # applied-but-response-lost: already bound right
+                    st = Status.success()
+                    attempt += 1     # counts as a recovered attempt
+                    break
+                if bound != "":
+                    # gone (None) or bound elsewhere: permanent — the
+                    # normal failure path handles it, no sleeps owed
+                    break
+                attempt += 1
+                time.sleep(delay)
+                delay = min(delay * 2,
+                            self.config.pod_max_backoff_seconds)
+                st = fwk.run_bind_plugins(state, pod, node_name)
+            if attempt and st.is_success():
+                if self.metrics is not None:
+                    self.metrics.recoveries.inc("bind-retry")
+                if self.recorder:
+                    self.recorder.event(
+                        pod, "Normal", "BindRetried",
+                        f"bind succeeded after {attempt} retr"
+                        f"{'y' if attempt == 1 else 'ies'}")
         if not st.is_success():
             self._forget(assumed)
             fwk.run_unreserve_plugins(state, pod, node_name)
@@ -1938,6 +2254,12 @@ class Scheduler:
             fr_rec.meta["aot"] = rt.stats()
             fr.commit_cycle(fr_rec)
         loaded = [r for r in report if r["ok"]]
+        failed = len(report) - len(loaded)
+        if failed and self.metrics is not None:
+            # corrupt/unreadable artifacts degraded to the per-bucket
+            # trace fallback (reasons in the preload report / aot-load
+            # flight spans) — count them as recoveries, not silence
+            self.metrics.recoveries.inc("aot-fallback", amount=failed)
         for r in loaded:
             self.prewarm_report.append(
                 (int(r.get("pod_bucket") or 0), round(r["seconds"], 2)))

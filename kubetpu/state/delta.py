@@ -70,6 +70,10 @@ from .tensors import (ClusterDelta, HostClusterArrays, SnapshotBuilder,
 
 RESYNC_INTERVAL_ENV = "KUBETPU_RESYNC_INTERVAL"
 MAX_FRAC_ENV = "KUBETPU_DELTA_MAX_FRAC"
+# anti-entropy VERIFIER cadence (delta cycles between device/mirror
+# fingerprint checks); 0 = off, the default — a disarmed run performs
+# zero extra readbacks (the chaos poison test enforces it)
+VERIFY_INTERVAL_ENV = "KUBETPU_VERIFY_INTERVAL"
 DEFAULT_RESYNC_INTERVAL = 512
 # dirty-fraction fallback is OFF by default (1.0 = never): even a
 # fully-dirty delta beats a rebuild — the refill walk is the same
@@ -82,6 +86,45 @@ DEFAULT_MAX_FRAC = 1.0
 _POD_FIELDS = (("_pod_kv_ids", -1), ("pod_key", False), ("pod_ns_hot", 0.0),
                ("pod_node", -1), ("pod_valid", False),
                ("pod_terminating", False))
+
+# fields excluded from the anti-entropy fingerprint: the dense label
+# one-hots exist ONLY on device (the mirror holds compact [., ML] id
+# lists and to_device densifies — state/tensors.py), so there is no
+# cheap host twin to sum against.  Their source id lists feed pod_key /
+# keymask / topo_pair, which ARE fingerprinted, so label-scatter faults
+# still surface; the documented blind spot is a corruption of the dense
+# kv bits alone.
+_FP_SKIP = ("kv", "pod_kv")
+
+
+def _wrapsum_host(x: np.ndarray) -> int:
+    """uint32 wrap-sum of a mirror array's element bits: bools count set
+    bits, floats sum their f32 bit patterns, ints sum mod 2^32 — the
+    exact integer twin of _wrapsum_dev (no float accumulation anywhere,
+    so the comparison is bit-exact at any size)."""
+    x = np.asarray(x)
+    if x.dtype == np.bool_:
+        v = x.astype(np.uint32)
+    elif np.issubdtype(x.dtype, np.floating):
+        v = np.ascontiguousarray(x.astype(np.float32)).view(np.uint32)
+    else:
+        v = x.astype(np.uint32)
+    return int(v.sum(dtype=np.uint64) & 0xFFFFFFFF)
+
+
+def _wrapsum_dev(x):
+    """Device twin of _wrapsum_host: a [""] uint32 scalar, computed with
+    EAGER ops (not a jit root — the verifier must not widen the census
+    compile surface; it runs off the hot path on a cadence)."""
+    import jax.numpy as jnp
+    from jax import lax
+    if x.dtype == jnp.bool_:
+        v = x.astype(jnp.uint32)
+    elif jnp.issubdtype(x.dtype, jnp.floating):
+        v = lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    else:
+        v = x.astype(jnp.uint32)
+    return jnp.sum(v, dtype=jnp.uint32)
 
 
 class DeltaStats(NamedTuple):
@@ -108,7 +151,8 @@ class DeltaTensorizer:
     def __init__(self, hard_pod_affinity_weight: int = 1, mesh=None,
                  profile: str = "",
                  resync_interval: Optional[int] = None,
-                 max_delta_frac: Optional[float] = None):
+                 max_delta_frac: Optional[float] = None,
+                 verify_interval: Optional[int] = None):
         self.builder = SnapshotBuilder(
             hard_pod_affinity_weight=hard_pod_affinity_weight)
         self.hard_pod_affinity_weight = hard_pod_affinity_weight
@@ -133,6 +177,15 @@ class DeltaTensorizer:
         self.caps = None                         # vocab signature
         self.cycles_since_resync = 0
         self.resync_count = 0
+        # anti-entropy verifier (fingerprint_device vs fingerprint_host
+        # every verify_interval delta cycles; 0 = off)
+        self.verify_interval = (verify_interval
+                                if verify_interval is not None
+                                else int(os.environ.get(
+                                    VERIFY_INTERVAL_ENV, "0")))
+        self.cycles_since_verify = 0
+        self.verify_count = 0
+        self.divergence_count = 0
 
     # ------------------------------------------------------------- helpers
 
@@ -151,6 +204,71 @@ class DeltaTensorizer:
         for uid, r in self.pod_row.items():
             out[r] = uid
         return out
+
+    # ------------------------------------------------------- anti-entropy
+
+    def fingerprint_device(self) -> np.ndarray:
+        """[K] uint32 per-table wrap-sums of the DEVICE residents — one
+        small readback (the eager per-leaf sums stack into one array and
+        transfer together)."""
+        import jax
+        import jax.numpy as jnp
+        vals = []
+        for name in type(self.cluster)._fields:
+            if name in _FP_SKIP:
+                continue
+            for leaf in jax.tree.leaves(getattr(self.cluster, name)):
+                vals.append(_wrapsum_dev(leaf))
+        return np.asarray(jnp.stack(vals))
+
+    def fingerprint_host(self) -> np.ndarray:
+        """The host mirror's twin of fingerprint_device, same leaf order
+        (ClusterTensors field order; term pytrees flatten identically)."""
+        import jax
+        a = self.host.arrays
+        vals = []
+        for name in type(self.cluster)._fields:
+            if name in _FP_SKIP:
+                continue
+            for leaf in jax.tree.leaves(a[name]):
+                vals.append(_wrapsum_host(leaf))
+        return np.asarray(vals, np.uint32)
+
+    def verify(self) -> bool:
+        """One anti-entropy check: True when the device residents match
+        the host mirror bit-for-bit under the per-table fingerprint."""
+        ok = bool(np.array_equal(self.fingerprint_device(),
+                                 self.fingerprint_host()))
+        self.verify_count += 1
+        if not ok:
+            self.divergence_count += 1
+        return ok
+
+    def _verify_tick(self, node_infos, names, pending):
+        """Cadence gate around verify(): returns (spans, stats) where
+        spans carries the verify span when a check ran and stats is the
+        divergence-triggered resync's DeltaStats (reason
+        "verify-divergence") or None when consistent / not due.  OFF
+        (verify_interval == 0, the default) this is two attribute reads
+        — no device work, no readback."""
+        if not self.verify_interval or self.cluster is None:
+            return (), None
+        self.cycles_since_verify += 1
+        if self.cycles_since_verify < self.verify_interval:
+            return (), None
+        self.cycles_since_verify = 0
+        tv = time.time()
+        ok = self.verify()
+        span = (("verify", tv, time.time()),)
+        if ok:
+            return span, None
+        # divergence: the mirror is the source of truth (refilled from
+        # NodeInfos each cycle), so the targeted repair is the blessed
+        # full resync — re-derives and re-uploads everything
+        _cluster, stats = self._resync(node_infos, names,
+                                       "verify-divergence", time.time(),
+                                       pending)
+        return span, stats._replace(spans=span + stats.spans)
 
     # ------------------------------------------------------------- refresh
 
@@ -184,7 +302,13 @@ class DeltaTensorizer:
                  if ni.generation != self.node_gen.get(ni.node_name)]
         if not dirty:
             self.cycles_since_resync += 1
-            return self.cluster, DeltaStats(0, False, "", ())
+            # the verifier ticks on zero-dirty cycles too: a corruption
+            # injected by the LAST scatter must not hide behind a quiet
+            # cluster until the next churn
+            vspan, vstats = self._verify_tick(node_infos, names, pending)
+            if vstats is not None:
+                return self.cluster, vstats
+            return self.cluster, DeltaStats(0, False, "", vspan)
         if len(dirty) > self.max_delta_frac * max(len(names), 1):
             return self._resync(node_infos, names, "delta-too-large", t0,
                                 pending)
@@ -311,10 +435,14 @@ class DeltaTensorizer:
         self.cluster = self._apply(delta, donate=donate,
                                    replace_terms=terms_dirty)
         self.cycles_since_resync += 1
+        spans = ((("delta-build", t0, t_build),) + term_span
+                 + (("delta-apply", t_build, time.time()),))
+        vspan, vstats = self._verify_tick(node_infos, names, pending)
+        if vstats is not None:
+            return self.cluster, vstats._replace(spans=spans
+                                                 + vstats.spans)
         return self.cluster, DeltaStats(
-            len(node_rows) + len(pod_rows), False, "",
-            (("delta-build", t0, t_build),) + term_span
-            + (("delta-apply", t_build, time.time()),))
+            len(node_rows) + len(pod_rows), False, "", spans + vspan)
 
     # ------------------------------------------------------------- resync
 
@@ -349,6 +477,9 @@ class DeltaTensorizer:
         self.free_rows = []
         self.caps = self.signature()
         self.cycles_since_resync = 0
+        # a resync re-uploads the mirror wholesale, so device == mirror
+        # by construction; restart the verify cadence
+        self.cycles_since_verify = 0
         self.resync_count += 1
         self._upload()
         return self.cluster, DeltaStats(
@@ -411,8 +542,11 @@ class DeltaTensorizer:
         import jax
         import jax.numpy as jnp
         a = self.host.arrays
-        ft = jax.tree.map(jnp.asarray, a["filter_terms"])
-        st = jax.tree.map(jnp.asarray, a["score_terms"])
+        # jnp.array, not asarray: these leaves join the DONATED cluster
+        # (see HostClusterArrays.to_device) — an aliased mirror buffer
+        # would be clobbered by the scatter's buffer reuse
+        ft = jax.tree.map(jnp.array, a["filter_terms"])
+        st = jax.tree.map(jnp.array, a["score_terms"])
         if self.mesh is not None:
             from ..parallel import mesh as pmesh
             ft = pmesh.replicate(ft, self.mesh)
@@ -422,6 +556,7 @@ class DeltaTensorizer:
     def _apply(self, delta: ClusterDelta, donate: bool,
                replace_terms: bool = False):
         from ..models import programs
+        from ..utils import chaos
         cluster = self.cluster
         if replace_terms:
             # swap the term pytrees BEFORE the jit call: the scatter
@@ -430,8 +565,21 @@ class DeltaTensorizer:
             # new cluster no longer uses anyway
             ft, st = self._device_terms()
             cluster = cluster._replace(filter_terms=ft, score_terms=st)
+        # chaos seam (utils/chaos.py "delta"): "drop" loses the scatter
+        # entirely (the mirror was already refilled, so device and host
+        # now silently diverge — the exact fault class the anti-entropy
+        # verifier exists to catch); "corrupt" applies the scatter, then
+        # flips one resident value the way a bad DMA would
+        act = chaos.action("delta")
+        if act == "drop":
+            return cluster
         if self.mesh is not None:
             from ..parallel import mesh as pmesh
-            return pmesh.sharded_apply_cluster_delta(
+            new = pmesh.sharded_apply_cluster_delta(
                 cluster, delta, self.mesh, donate=donate)
-        return programs.apply_cluster_delta(cluster, delta, donate=donate)
+        else:
+            new = programs.apply_cluster_delta(cluster, delta,
+                                               donate=donate)
+        if act == "corrupt":
+            new = new._replace(requested=new.requested.at[0, 0].add(1.0))
+        return new

@@ -140,7 +140,15 @@ class HostClusterArrays(NamedTuple):
         L = a["_kv_cap"]
         vals = [None if f in ("kv", "pod_kv") else a[f]
                 for f in ClusterTensors._fields]
-        dev = jax.tree.map(lambda x: x if x is None else jnp.asarray(x),
+        # jnp.array, NOT jnp.asarray: asarray zero-copies a 64-byte-
+        # aligned numpy buffer on CPU, and the delta scatter DONATES the
+        # cluster (programs.apply_cluster_delta) — XLA then reuses the
+        # aliased buffer for unrelated outputs and silently corrupts the
+        # HOST MIRROR these arrays belong to.  Small mirrors only align
+        # by malloc luck (flaky); production-sized ones are page-aligned
+        # (always).  Caught by the anti-entropy verifier's false-positive
+        # divergences; the copy is paid once per resync.
+        dev = jax.tree.map(lambda x: x if x is None else jnp.array(x),
                            ClusterTensors(*vals),
                            is_leaf=lambda x: x is None)
         return dev._replace(kv=_densify_ids(jnp.asarray(a["_kv_ids"]), L),

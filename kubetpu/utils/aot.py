@@ -287,7 +287,15 @@ class AotStore:
 
     def load(self, name: str) -> Dict[str, Any]:
         with open(os.path.join(self.root, name), "rb") as f:
-            return pickle.load(f)
+            data = f.read()
+        # chaos seam (utils/chaos.py "aot-load"): a truncated blob is
+        # what a torn deploy / partial rsync actually produces — the
+        # pickle failure below must flow through every caller's
+        # degrade-to-trace-path handling, never crash prewarm
+        from . import chaos
+        if chaos.action("aot-load") is not None:
+            data = data[:max(len(data) // 2, 1)]
+        return pickle.loads(data)
 
     def remove(self, name: str) -> None:
         try:
@@ -485,19 +493,27 @@ class AotRuntime:
                     continue
             t0 = time.time()
             ok = True
+            reason = None
             with flight_span("aot-load", program=row.get("program", "?"),
                              bucket=row.get("pod_bucket"), hit=True) as sp:
                 try:
                     blob = self.store.load(name)
                     fn = se.deserialize_and_load(
                         blob["payload"], blob["in_tree"], blob["out_tree"])
-                except Exception:
+                except Exception as e:
+                    # a corrupt/unreadable artifact (truncated blob, torn
+                    # deploy, chaos "aot-load") degrades THIS row to the
+                    # per-bucket trace fallback with the reason recorded;
+                    # prewarm keeps going — an artifact set is allowed to
+                    # be partially rotten without costing availability
                     LOG.warning("aot preload of %s failed; bucket falls "
                                 "back to the trace path", name,
                                 exc_info=True)
                     ok = False
+                    reason = "%s: %s" % (type(e).__name__, e)
                     if sp is not None:
                         sp.args["hit"] = False
+                        sp.args["reason"] = reason[:256]
                 dt = time.time() - t0
                 if sp is not None:
                     sp.args["seconds"] = round(dt, 4)
@@ -508,10 +524,13 @@ class AotRuntime:
             else:
                 with self._lock:
                     self._missing.add(key)
-            report.append({"program": row.get("program"),
-                           "variant": row.get("variant"),
-                           "pod_bucket": row.get("pod_bucket"),
-                           "seconds": round(dt, 4), "ok": ok})
+            entry = {"program": row.get("program"),
+                     "variant": row.get("variant"),
+                     "pod_bucket": row.get("pod_bucket"),
+                     "seconds": round(dt, 4), "ok": ok}
+            if reason is not None:
+                entry["reason"] = reason
+            report.append(entry)
         return report
 
     def _load(self, program: str, key: str, args: tuple):
@@ -660,6 +679,10 @@ class AotRuntime:
 
 _active: Optional[AotRuntime] = None
 _active_lock = threading.Lock()
+# why the runtime was last disarmed mid-run (the scheduler's
+# dispatch-recovery AOT->trace demotion records its reason here so
+# /debug and tests can see the ladder rung that fired); None = never
+_demotion_reason: Optional[str] = None   # kubelint: guarded-by(_active_lock)
 
 
 def active_runtime() -> Optional[AotRuntime]:
@@ -673,10 +696,27 @@ def arm(runtime: AotRuntime) -> AotRuntime:
     return runtime
 
 
-def disarm() -> None:
-    global _active
+def disarm(reason: Optional[str] = None) -> None:
+    """Disarm the runtime; a non-None reason marks this as a DEMOTION
+    (AOT->trace, the self-healing ladder) rather than a clean teardown."""
+    global _active, _demotion_reason
     with _active_lock:
         _active = None
+        if reason is not None:
+            _demotion_reason = reason
+
+
+def demotion_reason() -> Optional[str]:
+    with _active_lock:
+        return _demotion_reason
+
+
+def reset_demotion() -> None:
+    """Clear the demotion latch (operator/test hook) so
+    maybe_arm_from_env may arm again."""
+    global _demotion_reason
+    with _active_lock:
+        _demotion_reason = None
 
 
 def serve_runtime(root: str) -> AotRuntime:
@@ -697,6 +737,14 @@ def maybe_arm_from_env() -> Optional[AotRuntime]:
         return None
     if _active is not None:
         return _active
+    if demotion_reason() is not None:
+        # the self-healing ladder demoted AOT->trace in this process: a
+        # later Scheduler construction must not silently re-arm the
+        # artifact set that just faulted (explicit arm() still can,
+        # reset_demotion() clears the latch)
+        LOG.warning("AOT artifacts stay demoted (%s); serving the trace "
+                    "path", demotion_reason())
+        return None
     try:
         rt = serve_runtime(root)
     except Exception:  # pragma: no cover - index IO is already guarded

@@ -242,6 +242,19 @@ class SchedulerMetrics:
         self.flight_recorder_dropped = r(Counter(
             f"{p}_flight_recorder_dropped_total",
             "Cycle records dropped by the flight recorder's ring buffer."))
+        # self-healing runtime (utils/chaos.py + the recovery machinery):
+        # faults the armed chaos registry injected, by point, and the
+        # recoveries the runtime performed — dispatch-error /
+        # dispatch-deadline demotions, bind retries, anti-entropy
+        # verify resyncs, aot artifact fallbacks
+        self.faults_injected = r(Counter(
+            f"{p}_faults_injected_total",
+            "Faults injected by the armed chaos registry, by point.",
+            ("point",)))
+        self.recoveries = r(Counter(
+            f"{p}_recoveries_total",
+            "Self-healing recoveries performed by the runtime, by kind.",
+            ("kind",)))
 
     # hooks consumed by queue/scheduler ------------------------------------
 

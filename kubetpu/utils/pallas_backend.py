@@ -31,7 +31,14 @@ import numpy as np
 _INTERPRET_ENV = os.environ.get("KUBETPU_PALLAS_INTERPRET")
 
 _lock = threading.Lock()
-_fallbacks: Dict[str, int] = {}
+_fallbacks: Dict[str, int] = {}   # kubelint: guarded-by(_lock)
+# runtime demotion (the self-healing ladder's pallas->lax rung): set by
+# the scheduler's deadline-guarded dispatch when a pallas-backed cycle
+# errors or blows its deadline; unsupported_reason() then refuses the
+# backend process-wide until reset, so every later cycle — including
+# other profiles' — serves the lax oracle path instead of re-tripping
+# the same fault
+_demotion: Optional[str] = None   # kubelint: guarded-by(_lock)
 
 
 def available() -> bool:
@@ -69,6 +76,9 @@ def unsupported_reason(cfg, intra_batch_topology: bool,
     inspection is free — no device sync.  A caller passing device-array
     batches (never the serving path) skips the check and carries the
     term-free contract itself."""
+    demoted = demotion()
+    if demoted is not None:
+        return "demoted:%s" % demoted
     if not available():
         return "pallas-unavailable"
     if intra_batch_topology:
@@ -82,6 +92,26 @@ def unsupported_reason(cfg, intra_batch_topology: bool,
         if isinstance(sv, np.ndarray) and bool(sv.any()):
             return "soft-spread-constraints"
     return None
+
+
+def demote(reason: str) -> None:
+    """Demote the pallas backend process-wide with a recorded reason
+    (scheduler dispatch-recovery hook); idempotent, first reason wins."""
+    global _demotion
+    with _lock:
+        if _demotion is None:
+            _demotion = reason
+
+
+def demotion() -> Optional[str]:
+    with _lock:
+        return _demotion
+
+
+def reset_demotion() -> None:
+    global _demotion
+    with _lock:
+        _demotion = None
 
 
 def note_fallback(reason: str) -> None:

@@ -484,19 +484,26 @@ def flight_span(name: str, **args):
         yield sp
 
 
-def note_compile_event(program: str, shapes: str) -> None:
-    """Sanitize-watchdog hook: record an XLA (re)compile as an instant
-    event on the cycle currently open on this thread (compiles triggered
-    by a cycle's dispatch happen under its dispatch span).  Disarmed or
-    outside a cycle this is a no-op."""
+def note_instant(name: str, **args) -> None:
+    """Record an instant event on the cycle currently open on this
+    thread — the hook code with no handle on the cycle's Trace uses
+    (sanitize watchdog recompiles, chaos-harness fault injections,
+    backend demotions).  Disarmed or outside a cycle this is a no-op."""
     if _flight is None:
         return
     stack = _span_stack()
     if not stack:
         return
     rec, parent = stack[-1]
-    rec.event("xla-compile", parent_id=parent.span_id if parent else 0,
-              program=program, shapes=shapes[:512])
+    rec.event(name, parent_id=parent.span_id if parent else 0, **args)
+
+
+def note_compile_event(program: str, shapes: str) -> None:
+    """Sanitize-watchdog hook: record an XLA (re)compile as an instant
+    event on the cycle currently open on this thread (compiles triggered
+    by a cycle's dispatch happen under its dispatch span).  Disarmed or
+    outside a cycle this is a no-op."""
+    note_instant("xla-compile", program=program, shapes=shapes[:512])
 
 
 # --------------------------------------------------------------------- Trace

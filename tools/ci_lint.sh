@@ -14,9 +14,12 @@ cd "$(dirname "$0")/.."
 python -m tools.kubelint kubetpu/ --json
 # explicit concurrency-family pass over the observability layer: the new
 # lock-guarded recorder/audit classes must be clean on their own, so a
-# future refactor can't hide a violation behind an unrelated suppression
+# future refactor can't hide a violation behind an unrelated suppression.
+# The chaos registry rides the same pass: its fire counters are
+# guarded-by annotated and its decide/act split must never sleep or
+# raise under the lock (blocking-under-lock)
 python -m tools.kubelint kubetpu/utils/trace.py kubetpu/utils/decisions.py \
-	--rules concurrency --json
+	kubetpu/utils/chaos.py --rules concurrency --json
 # explicit delta-family pass over the serving loop: the cycle path must
 # stay scatter-only (full-retensorize-in-loop), independent of any
 # unrelated suppression elsewhere in the tree
@@ -46,3 +49,10 @@ python -m tools.kubeaot --check --json
 # pytest skip (the suite's module-level skipif), never a failure.
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
 	tests/test_pallas_gang.py -q -m 'not slow' -p no:cacheprovider
+# Chaos harness + self-healing runtime (utils/chaos.py): every named
+# injection point's seeded recovery scenario — serving thread alive, no
+# lost pods, no double binds, mirror/device fingerprint match after
+# induced faults — and the disarmed-no-op poison test (a disarmed run
+# adds zero locks and zero readbacks to the hot path).
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
+	tests/test_chaos.py -q -m 'not slow' -p no:cacheprovider
