@@ -4,7 +4,7 @@
 
 .PHONY: help lint lock-graph test sanitize-test race-test flight-test \
 	delta-test census census-test aot aot-test pallas-test chaos-test \
-	trace bench
+	slo-test trend trace bench
 
 help:
 	@echo "kubetpu targets:"
@@ -49,6 +49,14 @@ help:
 	@echo "                      scatter, aot load, bind/extender/watch"
 	@echo "                      transport), deadline demotion, anti-entropy"
 	@echo "                      verifier, disarmed-no-op poison test"
+	@echo "  make slo-test       per-pod latency SLO suite (utils/slo.py):"
+	@echo "                      sketch-vs-numpy quantile property, bounded"
+	@echo "                      memory, disarmed zero-lock poison, /debug/slo"
+	@echo "                      round trip, exemplar links, armed-vs-disarmed"
+	@echo "                      placement parity"
+	@echo "  make trend          per-case bench trend table over the committed"
+	@echo "                      BENCH_r*.json trajectory with per-stage"
+	@echo "                      regression attribution (tools/benchtrend.py)"
 	@echo "  make trace          run the pipelined drain with the flight"
 	@echo "                      recorder armed, write PIPELINE_TRACE.json +"
 	@echo "                      .perfetto.json, print the text flame summary"
@@ -127,6 +135,18 @@ pallas-test:
 chaos-test:
 	JAX_PLATFORMS=cpu python -m pytest \
 		tests/test_chaos.py -q -m 'not slow' -p no:cacheprovider
+
+# per-pod latency SLO layer (kubetpu/utils/slo.py): streaming quantile
+# sketch correctness, the disarmed-hot-path zero-lock contract, the
+# /debug/slo endpoint, exemplar->flight-recorder linkage, and the
+# golden parity proof that arming changes zero placements
+slo-test:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_slo.py -q -p no:cacheprovider
+
+# bench trend table + regression attribution over the committed rounds
+trend:
+	python -m tools.benchtrend
 
 # pipelined-drain trace via the flight recorder + text flame summary
 # (PIPELINE_TRACE.json + PIPELINE_TRACE.perfetto.json for ui.perfetto.dev)

@@ -135,6 +135,40 @@ def _spread(raw):
             "max_s": round(max(raw), 3)}
 
 
+def _slo_tracker():
+    """The armed per-pod latency tracker (main() arms it for the whole
+    run, next to the flight recorder), or None under a caller that did
+    not arm it — every consumer degrades to no latency block."""
+    from kubetpu.utils import slo as uslo
+    return uslo.tracker()
+
+
+def _latency_block(trk):
+    """The per-case per-pod ``latency`` block: e2e p50/p90/p99 (the SLO
+    numbers — "100k pods x 10k nodes < 1 s p99" is judged on
+    pod_e2e_p99_s) plus each stage's share of the total per-pod latency
+    sum, the attribution vector tools/benchtrend.py diffs to name which
+    stage a regression grew in.  None when the tracker is disarmed or
+    saw no terminal pods."""
+    if trk is None:
+        return None
+    stages = trk.stage_quantiles()
+    e2e = stages.get("e2e")
+    if not e2e or not e2e.get("count"):
+        return None
+    return {
+        "pods": e2e["count"],
+        "pod_e2e_p50_s": e2e.get("p50_s", 0.0),
+        "pod_e2e_p90_s": e2e.get("p90_s", 0.0),
+        "pod_e2e_p99_s": e2e.get("p99_s", 0.0),
+        "pod_e2e_max_s": e2e.get("max_s", 0.0),
+        "stage_p99_s": {name: st.get("p99_s", 0.0)
+                        for name, st in stages.items()
+                        if name != "e2e" and st.get("count")},
+        "stage_shares": trk.shares(),
+    }
+
+
 def _rounds_hist(cycle_rounds):
     """Per-cycle auction round HISTOGRAM {rounds: cycles} — the shape of
     the round distribution, not just its max, so a megakernel/windowing
@@ -174,9 +208,14 @@ def run_mode(mode, n_nodes, n_pods, existing_per_node, repeats,
     outcomes = sched = None
     raw_s = []            # every attempt's e2e seconds, in order
     compile_split = {}    # attempt 0's timer delta
+    slo_trk = _slo_tracker()
     for attempt in range(repeats + 1):
         if sched is not None:
             sched.close()
+        if slo_trk is not None:
+            # the latency block describes the LAST attempt's drain (the
+            # same attempt the stats dict survives from)
+            slo_trk.clear()
         store, pending = build_world(n_nodes, n_pods, existing_per_node,
                                      ipa_heavy=ipa_heavy)
         cfg = KubeSchedulerConfiguration(
@@ -223,6 +262,9 @@ def run_mode(mode, n_nodes, n_pods, existing_per_node, repeats,
             "delta_rows_p50": _median(list(sched.delta_rows)),
             "resync_count": sched.resync_count,
         }
+        latency = _latency_block(slo_trk)
+        if latency is not None:
+            stats["latency"] = latency
         if compile_split.get("compile_s", 0) or compile_split.get(
                 "cache_load_s", 0):
             # measured split (overrides mode_summary's wall-clock
@@ -321,12 +363,16 @@ def _gate_path(detail, dotted):
     return cur if isinstance(cur, (int, float)) else None
 
 
-def gate_entries(detail):
+def gate_entries(detail, northstar=None):
     """Build the NORTHSTAR.json "gate" section from a run's detail doc:
     dotted-path throughput metrics with a floor fraction derived from the
     recorded min/median warm spread (a current run below
     value * min_frac is a regression, not tunnel variance).  Recorded by
-    BENCH_FULL=1 runs; consumed by northstar_gate (BENCH_GATE=1)."""
+    BENCH_FULL=1 runs; consumed by northstar_gate (BENCH_GATE=1).
+    northstar: the BENCH_FULL shapes doc — adds the rescore_p99_s
+    latency CEILING (the per-pod p99 the ROADMAP item 1 SLO is judged
+    on; falls back to the per-cycle p99 on runs without the SLO layer
+    armed)."""
     out = {}
 
     def rel_spread(spread):
@@ -359,6 +405,20 @@ def gate_entries(detail):
     if isinstance(wr.get("cold_restart_s"), (int, float)):
         out["warm_restart.cold_restart_s"] = {
             "seconds": wr["cold_restart_s"], "max_frac": 2.0}
+    # rescore p99 latency CEILING (ROADMAP item 1's SLO axis): per-pod
+    # e2e p99 when the SLO tracker was armed, per-cycle p99 otherwise.
+    # The "path" field names the dotted detail location northstar_gate
+    # reads the current run's value from (entries without it use their
+    # own key as the path)
+    rs = (northstar or {}).get("rescore_stream") or {}
+    p99 = (rs.get("latency") or {}).get("pod_e2e_p99_s")
+    path = "northstar.rescore_stream.latency.pod_e2e_p99_s"
+    if not isinstance(p99, (int, float)):
+        p99 = rs.get("cycle_p99_s")
+        path = "northstar.rescore_stream.cycle_p99_s"
+    if isinstance(p99, (int, float)) and p99 > 0:
+        out["rescore_p99_s"] = {"seconds": round(p99, 3), "max_frac": 2.0,
+                                "path": path}
     return out
 
 
@@ -391,7 +451,10 @@ def northstar_gate(detail, path="NORTHSTAR.json"):
     except (OSError, ValueError):
         return failures
     for dotted, ref in sorted((doc.get("gate") or {}).items()):
-        cur = _gate_path(detail, dotted)
+        # an entry may carry an explicit dotted "path" (e.g. the
+        # rescore_p99_s ceiling reads northstar.rescore_stream.*);
+        # without one the key itself is the path
+        cur = _gate_path(detail, ref.get("path", dotted))
         if cur is None:
             continue
         secs = ref.get("seconds")
@@ -555,6 +618,9 @@ def node_flap_case(n_nodes=256, n_pods=1024, waves=4, flap=24):
     from kubetpu.scheduler import Scheduler
 
     rng = random.Random(0)
+    slo_trk = _slo_tracker()
+    if slo_trk is not None:
+        slo_trk.clear()
     store = ClusterStore()
     nodes = hollow.make_nodes(n_nodes, zones=8)
     for n in nodes:
@@ -604,6 +670,9 @@ def node_flap_case(n_nodes=256, n_pods=1024, waves=4, flap=24):
         "delta_rows_p50": _median(list(sched.delta_rows)),
         "recoveries": len(sched.recovery_log),
     }
+    latency = _latency_block(slo_trk)
+    if latency is not None:
+        stats["latency"] = latency
     sched.close()
     return stats
 
@@ -818,7 +887,10 @@ def rescore_case(n_pods=51200, n_nodes=10240, chunk=4096):
     out = {}
     first_e2e = None
     raw_s = []
+    slo_trk = _slo_tracker()
     for attempt in range(2):   # attempt 0 pays the P-bucket compile ladder
+        if slo_trk is not None:
+            slo_trk.clear()
         store, pending = build_world(n_nodes, n_pods, existing_per_node=1)
         cfg = KubeSchedulerConfiguration(
             profiles=[KubeSchedulerProfile()], batch_size=chunk, mode="gang",
@@ -864,6 +936,9 @@ def rescore_case(n_pods=51200, n_nodes=10240, chunk=4096):
             "scheduled": scheduled,
             "hbm_peak_bytes": int(mem.get("peak_bytes_in_use", 0)),
         }
+        latency = _latency_block(slo_trk)
+        if latency is not None:
+            out["latency"] = latency
         if scheduled < len(outcomes):
             out["unscheduled"] = len(outcomes) - scheduled
         sched.close()
@@ -981,6 +1056,12 @@ def main() -> None:
     # Perfetto-loadable PIPELINE_TRACE.perfetto.json below
     from kubetpu.utils import trace as utrace
     flight = utrace.arm_flight_recorder()
+    # ...and the per-pod latency SLO tracker rides next to it: every
+    # case's JSON carries the per-pod latency block (pod_e2e_p50/p90/p99
+    # + per-stage shares), and the pipeline doc gains the "slo" section
+    # traceview digests
+    from kubetpu.utils import slo as uslo
+    uslo.arm_slo_tracker()
 
     detail = {"backend": jax.default_backend(), "pending": n_pods,
               "nodes": n_nodes}
@@ -1101,7 +1182,7 @@ def main() -> None:
             northstar["warm_restart_5120n"] = {"error": repr(e)}
         # record drift-gate floors for this backend next to the northstar
         # shapes, so BENCH_GATE=1 runs can detect regressions
-        northstar["gate"] = gate_entries(detail)
+        northstar["gate"] = gate_entries(detail, northstar)
         detail["northstar"] = northstar
         atomic_write_json("NORTHSTAR.json", northstar)
 

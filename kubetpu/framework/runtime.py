@@ -17,7 +17,9 @@ for everything else.
 
 from __future__ import annotations
 
+import functools
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 from ..api import types as api
@@ -27,6 +29,39 @@ from .interface import Code, CycleState, Status, TensorPlugin, WaitingPod, Waiti
 from .provider import default_plugins
 
 MAX_PERMIT_TIMEOUT = 600.0  # reference: interface.go maxTimeout 15min; we cap lower
+
+
+def _status_label(result) -> str:
+    """Status label for the extension-point histogram (reference:
+    framework.go frameworkMetric status values)."""
+    st = result[1] if isinstance(result, tuple) else result
+    if st is None or st.is_success():
+        return "Success"
+    if st.code == Code.WAIT:
+        return "Wait"
+    return "Unschedulable" if st.is_unschedulable() else "Error"
+
+
+def _timed_point(point: str):
+    """Observe scheduler_framework_extension_point_duration_seconds for
+    one host extension point (reference: framework.go:369,660,678,708,
+    818 each wrap their run in metrics.ObserveExtensionPoint).  Only the
+    per-pod-per-cycle points are instrumented — the per-(pod, node)
+    Filter loop is deliberately unsampled (see utils/metrics.py note).
+    Without a metrics registry the wrapper is one attribute read."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, *args, **kwargs):
+            m = self.metrics
+            if m is None:
+                return fn(self, *args, **kwargs)
+            t0 = time.time()
+            result = fn(self, *args, **kwargs)
+            m.framework_extension_point_duration.observe(
+                time.time() - t0, point, _status_label(result))
+            return result
+        return wrapper
+    return deco
 
 
 class Framework:
@@ -129,6 +164,7 @@ class Framework:
 
     # -- extension points (host plugins only; see module docstring) ---------
 
+    @_timed_point("PreFilter")
     def run_pre_filter_plugins(self, state: CycleState, pod: api.Pod) -> Status:
         # reference: framework.go:369
         for p in self.host_pre_filter_plugins:
@@ -205,6 +241,7 @@ class Framework:
             out[p.name()] = [s * w for _, s in scores]
         return out
 
+    @_timed_point("Reserve")
     def run_reserve_plugins(self, state: CycleState, pod: api.Pod,
                             node_name: str) -> Status:
         # reference: framework.go:660
@@ -224,6 +261,7 @@ class Framework:
             if self._relevant(p, pod):
                 p.unreserve(state, pod, node_name)
 
+    @_timed_point("Permit")
     def run_permit_plugins(self, state: CycleState, pod: api.Pod,
                            node_name: str) -> Status:
         """reference: framework.go:818 — collects Wait verdicts into a
@@ -252,15 +290,24 @@ class Framework:
         return Status.success()
 
     def wait_on_permit(self, pod: api.Pod) -> Status:
-        # reference: framework.go:775 WaitOnPermit
+        # reference: framework.go:775 WaitOnPermit — the permit-wait
+        # histogram is observed only for pods that actually entered a
+        # Wait (result: allowed/rejected, matching the reference labels)
         wp = self.waiting_pods.get(pod.uid)
         if wp is None:
             return Status.success()
+        t0 = time.time()
         try:
-            return wp.wait()
+            st = wp.wait()
         finally:
             self.waiting_pods.remove(pod.uid)
+        if self.metrics is not None:
+            self.metrics.permit_wait_duration.observe(
+                time.time() - t0,
+                "allowed" if st.is_success() else "rejected")
+        return st
 
+    @_timed_point("PreBind")
     def run_pre_bind_plugins(self, state: CycleState, pod: api.Pod,
                              node_name: str) -> Status:
         # reference: framework.go:678
@@ -274,6 +321,7 @@ class Framework:
                     f'{st.message()}')
         return Status.success()
 
+    @_timed_point("PostFilter")
     def run_post_filter_plugins(self, state: CycleState, pod: api.Pod,
                                 filtered_node_status=None):
         """reference: framework.go:514 RunPostFilterPlugins — run until the
@@ -291,6 +339,7 @@ class Framework:
             reasons.extend(st.reasons)
         return None, Status(Code.UNSCHEDULABLE, reasons)
 
+    @_timed_point("Bind")
     def run_bind_plugins(self, state: CycleState, pod: api.Pod,
                          node_name: str) -> Status:
         # reference: framework.go:708 — SKIP falls through to the next binder
@@ -305,6 +354,7 @@ class Framework:
             f"all bind plugins skipped binding pod "
             f"{pod.namespace}/{pod.metadata.name}"])
 
+    @_timed_point("PostBind")
     def run_post_bind_plugins(self, state: CycleState, pod: api.Pod,
                               node_name: str) -> None:
         for p in self.post_bind_plugins:

@@ -197,12 +197,23 @@ class QueuedPodInfo:
     # scheduler.go:515 podSchedulingCycle := SchedulingQueue.SchedulingCycle()
     # is read at pop time, not at failure time)
     scheduling_cycle: int = 0
+    # when the pod was popped into its current cycle — stamped by the
+    # queue ONLY while the SLO tracker (utils/slo.py) is armed; 0.0 means
+    # "never stamped" and the SLO layer skips the pod
+    pop_timestamp: float = 0.0
+    # the SLO layer already recorded an "unresolvable" vector for this
+    # pod: requeued pods retry, and re-recording every failing cycle
+    # would multi-count the pod in the sketches (a later successful bind
+    # still records its own "bound" vector)
+    slo_unres_observed: bool = False
 
     def deep_copy(self) -> "QueuedPodInfo":
         return QueuedPodInfo(pod=self.pod, timestamp=self.timestamp,
                              attempts=self.attempts,
                              initial_attempt_timestamp=self.initial_attempt_timestamp,
-                             scheduling_cycle=self.scheduling_cycle)
+                             scheduling_cycle=self.scheduling_cycle,
+                             pop_timestamp=self.pop_timestamp,
+                             slo_unres_observed=self.slo_unres_observed)
 
 
 # ---------------------------------------------------------------------------

@@ -440,6 +440,9 @@ class Preemptor:
             # tensorization on the cycle-less direct path
             if self._eligible(p):
                 fresh.append(p)
+        if fresh and sched.metrics is not None:
+            # reference: metrics.PreemptionAttempts.Inc() per Preempt call
+            sched.metrics.preemption_attempts.inc(amount=len(fresh))
         if fresh and cycle is None:
             cycle = self._build_cycle(fwk, fresh)
         try:
@@ -536,6 +539,9 @@ class Preemptor:
         sched = self.sched
         table = cycle.builder.table
         R = int(cycle.cluster.requested.shape[1])
+        if victims.pods and sched.metrics is not None:
+            # reference: metrics.PreemptionVictims.Observe per preemptor
+            sched.metrics.preemption_victims.observe(len(victims.pods))
         for victim in victims.pods:
             try:
                 sched.store.delete(victim)

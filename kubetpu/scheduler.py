@@ -46,6 +46,7 @@ from .state.cache import SchedulerCache, Snapshot
 from .state.delta import DeltaTensorizer
 from .state.tensors import SnapshotBuilder
 from .utils import chaos as uchaos
+from .utils import slo as uslo
 from .utils import trace as utrace
 from .utils.decisions import DecisionLog, PodDecision
 from .utils.trace import Trace
@@ -110,6 +111,12 @@ class PreparedCycle:
     # must not count against the dispatch deadline (a device hang still
     # counts — it blocks the READBACK, which runs after pickup)
     parked_t: float = 0.0
+    # packed-readback completion time + the readback's device wait — the
+    # SLO layer's commit-stage anchor and per-pod device share (stamped
+    # unconditionally in _readback_group: two float stores, no clock call
+    # beyond the one the wait measurement already makes)
+    readback_done_t: float = 0.0
+    device_wait: float = 0.0
 
 
 class Scheduler:
@@ -131,6 +138,10 @@ class Scheduler:
         # KUBETPU_CHAOS: arm the fault-injection registry (utils/chaos.py);
         # disarmed (the default) every injection site is one attribute read
         uchaos.maybe_arm_from_env()
+        # KUBETPU_SLO: arm the per-pod latency SLO tracker (utils/slo.py);
+        # disarmed (the default) every seam is one attribute read and the
+        # hot path takes zero new locks (tests/test_slo.py poison test)
+        uslo.maybe_arm_from_env()
         import jax
         self.store = store
         self.config = config or KubeSchedulerConfiguration(
@@ -1281,7 +1292,10 @@ class Scheduler:
         with prep.trace.stage("packed-readback") as sp:
             t_dev = time.time()
             packed = np.asarray(res.packed)
-            wait = time.time() - t_dev
+            t_done = time.time()
+            wait = t_done - t_dev
+            prep.readback_done_t = t_done
+            prep.device_wait = wait
             if sp is not None:
                 # per-span device-wait attribution: the readback is the
                 # cycle's only observable device sync
@@ -1324,6 +1338,16 @@ class Scheduler:
         commit_failed = False
         audit = self.decisions.enabled
         flight = trace.rec
+        # per-pod latency SLO (utils/slo.py): one tracker read per cycle;
+        # disarmed, no stage vectors are built and no clock is read — the
+        # zero-new-locks hot-path contract (tests/test_slo.py)
+        slo_trk = uslo.tracker()
+        slo_host_dispatch = 0.0
+        if slo_trk is not None and prep.dispatch_t0:
+            # host share of the dispatch->readback window (enqueue +
+            # overlapped host work); the device share is prep.device_wait
+            slo_host_dispatch = max(prep.readback_done_t - prep.dispatch_t0
+                                    - prep.device_wait, 0.0)
         for i, qp in enumerate(live):
             state = states[qp.pod.uid]
             if chosen[i] < 0:
@@ -1333,10 +1357,12 @@ class Scheduler:
                                  not unres[i]))
                 continue
             node_name = node_infos[chosen[i]].node_name
+            slo = (self._slo_prefix(qp, prep, slo_host_dispatch, flight)
+                   if slo_trk is not None and qp.pop_timestamp else None)
             outcome = self._commit(fwk, qp, state, node_name,
                                    n_feas[i], pinfo=pinfos[i],
                                    host_relevant=prep.host_relevant[qp.pod.uid],
-                                   flight=flight)
+                                   flight=flight, slo=slo)
             if outcome.node:
                 # preemption for pods failing later in this batch must see
                 # this placement (CycleContext.cluster_now overlay)
@@ -1413,6 +1439,19 @@ class Scheduler:
                     nominated_node=qp.pod.status.nominated_node_name or "",
                     host_reasons=prep.host_reject.get(qp.pod.uid),
                     **info)
+            if (slo_trk is not None and not mh and qp.pop_timestamp
+                    and not qp.slo_unres_observed):
+                # terminally unresolvable this cycle (no plugin verdict
+                # can change and preemption cannot help): record the
+                # vector now — there is no bind stage to wait for.
+                # Once per pod: the requeue path retries it every
+                # cluster event, and re-recording each failing cycle
+                # would multi-count the pod in the sketches
+                qp.slo_unres_observed = True
+                self._slo_observe_terminal(
+                    slo_trk,
+                    self._slo_prefix(qp, prep, slo_host_dispatch, flight),
+                    qp, "unresolvable")
         # a commit-path failure invalidates the speculative chain (and any
         # later cycle already dispatched against it — the pipelined drain
         # reads _last_commit_failed and re-runs that cycle)
@@ -1423,6 +1462,49 @@ class Scheduler:
         trace.step("Committing placements done")
         trace.log_if_long()
         return outcomes
+
+    @staticmethod
+    def _slo_prefix(qp: QueuedPodInfo, prep: PreparedCycle,
+                    host_dispatch: float, flight) -> Dict[str, float]:
+        """The cycle-side half of a pod's per-stage latency vector
+        (utils/slo.py): queue_wait/backoff/cycle_wait/dispatch/device,
+        plus two underscore-prefixed meta keys the terminal observer
+        pops before recording (the readback anchor for the commit stage
+        and the flight-recorder cycle seq the exemplar links to).
+        Called only with the tracker armed and a stamped pop time."""
+        return {
+            "queue_wait": max(qp.pop_timestamp - qp.timestamp, 0.0),
+            "backoff": max(qp.timestamp - qp.initial_attempt_timestamp,
+                           0.0),
+            "cycle_wait": max((prep.dispatch_t0 or qp.pop_timestamp)
+                              - qp.pop_timestamp, 0.0),
+            "dispatch": host_dispatch,
+            "device": prep.device_wait,
+            "_readback_done_t": prep.readback_done_t,
+            "_flight_seq": float(flight.seq) if flight is not None else 0.0,
+        }
+
+    def _slo_observe_terminal(self, trk, prefix: Dict[str, float],
+                              qp: QueuedPodInfo, outcome: str,
+                              bind_start: Optional[float] = None) -> None:
+        """Complete a pod's cycle-side stage vector (_slo_prefix) with
+        the terminal stages — commit (readback -> bind start, or ->
+        now for failures), bind (when one ran), e2e — and record it.
+        The ONLY consumer of the prefix's underscore meta keys."""
+        now = time.time()
+        stages = dict(prefix)
+        seq = stages.pop("_flight_seq", 0)
+        rb = stages.pop("_readback_done_t", 0.0)
+        end = bind_start if bind_start is not None else now
+        stages["commit"] = max(end - rb, 0.0)
+        if bind_start is not None:
+            stages["bind"] = max(now - bind_start, 0.0)
+        stages["e2e"] = now - qp.initial_attempt_timestamp
+        pod = qp.pod
+        trk.observe_pod(stages, pod=pod.metadata.name,
+                        namespace=pod.namespace, uid=pod.uid,
+                        outcome=outcome, attempts=qp.attempts,
+                        cycle=self.cycle_count, flight_seq=int(seq))
 
     def _sync_chaos_metrics(self) -> None:
         """Fold the armed chaos registry's fire counts into
@@ -1693,7 +1775,7 @@ class Scheduler:
                 node_name: str, n_feasible: int,
                 binder_override=None, pinfo: Optional[PodInfo] = None,
                 host_relevant: Optional[bool] = None,
-                flight=None) -> ScheduleOutcome:
+                flight=None, slo=None) -> ScheduleOutcome:
         pod = qp.pod
         if host_relevant is None:
             host_relevant = fwk.has_relevant_host_filters(pod)
@@ -1753,13 +1835,13 @@ class Scheduler:
             try:
                 fut = self._bind_pool.submit(self._bind_cycle, fwk, qp,
                                              state, assumed, node_name,
-                                             binder_override, flight)
+                                             binder_override, flight, slo)
             except RuntimeError:
                 # close() raced the serving loop and shut the pool down
                 # mid-cycle: bind synchronously so the placement still
                 # lands instead of panicking the cycle
                 err = self._bind_cycle(fwk, qp, state, assumed, node_name,
-                                       binder_override, flight)
+                                       binder_override, flight, slo)
             else:
                 # prune completed futures so a long-running scheduler
                 # doesn't retain one CycleState + pod copy per pod
@@ -1769,23 +1851,28 @@ class Scheduler:
                 err = None
         else:
             err = self._bind_cycle(fwk, qp, state, assumed, node_name,
-                                   binder_override, flight)
+                                   binder_override, flight, slo)
         return ScheduleOutcome(pod=pod, node=node_name if err is None else "",
                                err=err, n_feasible=n_feasible)
 
     def _bind_cycle(self, fwk: Framework, qp: QueuedPodInfo, state: CycleState,
                     assumed: api.Pod, node_name: str,
-                    binder_override=None, flight=None) -> Optional[str]:
+                    binder_override=None, flight=None,
+                    slo=None) -> Optional[str]:
         """reference: scheduler.go:628-687.  flight: the cycle's
         CycleRecord — per-pod bind spans land on it from whichever thread
-        runs the bind (capped per record; None when disarmed)."""
+        runs the bind (capped per record; None when disarmed).  slo: the
+        pod's cycle-side stage vector (_slo_prefix) — the bind completes
+        it with commit/bind/e2e and records the terminal pod (None when
+        the tracker is disarmed)."""
         if flight is not None:
             with flight.span("bind", pod=qp.pod.metadata.name,
                              node=node_name):
                 return self._bind_cycle_inner(fwk, qp, state, assumed,
-                                              node_name, binder_override)
+                                              node_name, binder_override,
+                                              slo)
         return self._bind_cycle_inner(fwk, qp, state, assumed, node_name,
-                                      binder_override)
+                                      binder_override, slo)
 
     def _bound_node(self, pod: api.Pod):
         """The API's current view of a pod's binding: the node name,
@@ -1801,8 +1888,8 @@ class Scheduler:
 
     def _bind_cycle_inner(self, fwk: Framework, qp: QueuedPodInfo,
                           state: CycleState, assumed: api.Pod,
-                          node_name: str,
-                          binder_override=None) -> Optional[str]:
+                          node_name: str, binder_override=None,
+                          slo=None) -> Optional[str]:
         pod = qp.pod
         st = fwk.wait_on_permit(pod)
         if not st.is_success():
@@ -1883,6 +1970,11 @@ class Scheduler:
             self.metrics.pod_scheduled(
                 qp.attempts, now - qp.initial_attempt_timestamp,
                 now - qp.timestamp)
+        if slo is not None:
+            trk = uslo.tracker()
+            if trk is not None:
+                self._slo_observe_terminal(trk, slo, qp, "bound",
+                                           bind_start=bind_start)
         if self.recorder:
             self.recorder.event(pod, "Normal", "Scheduled",
                                 f"Successfully assigned "

@@ -10,7 +10,10 @@ ring (``?format=chrome`` returns Perfetto-loadable Chrome trace-event
 JSON), ``/debug/explain?pod=<name>[&namespace=<ns>]`` answers the per-pod
 "why (un)scheduled" audit from the scheduler's DecisionLog (no pod
 parameter lists the most recent decisions; ``?outcome=unschedulable``
-filters).
+filters), and ``/debug/slo`` serves the per-pod latency SLO document
+(utils/slo.py: per-stage p50/p90/p99/p999 + worst-pod exemplars linking
+to the flight-recorder cycle and decision-audit entry; 404 while the
+tracker is disarmed, ``?stage=`` filters, bad parameters are 400).
 """
 
 from __future__ import annotations
@@ -22,6 +25,7 @@ from dataclasses import asdict, is_dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from .utils import slo as uslo
 from .utils import trace as utrace
 
 
@@ -64,7 +68,14 @@ class SchedulerServer:
                 if fmt in ("chrome", "perfetto"):
                     self._send_json(200, fr.to_chrome_trace())
                 else:
-                    self._send_json(200, fr.to_dict())
+                    doc = fr.to_dict()
+                    # a saved flightz dump feeds traceview's "SLO:"
+                    # digest too when the latency tracker is armed
+                    trk = uslo.tracker()
+                    if trk is not None:
+                        doc["slo"] = {"stages": trk.stage_quantiles(),
+                                      "shares": trk.shares()}
+                    self._send_json(200, doc)
 
             def _explain(self, query) -> None:
                 log = getattr(sched, "decisions", None)
@@ -96,6 +107,37 @@ class SchedulerServer:
                     return
                 self._send_json(200, decision.to_dict())
 
+            def _slo(self, query) -> None:
+                trk = uslo.tracker()
+                if trk is None:
+                    self._send_json(404, {
+                        "armed": False,
+                        "error": "the SLO tracker is disarmed",
+                        "hint": "arm with KUBETPU_SLO=1 or "
+                                "kubetpu.utils.slo.arm_slo_tracker()"})
+                    return
+                doc = trk.to_dict()
+                stage = (query.get("stage") or [None])[0]
+                if stage is not None:
+                    if stage not in doc["stages"]:
+                        self._send_json(400, {
+                            "error": f"unknown stage {stage!r}",
+                            "stages": sorted(doc["stages"])})
+                        return
+                    doc["stages"] = {stage: doc["stages"][stage]}
+                raw_n = (query.get("n") or [None])[0]
+                if raw_n is not None:
+                    try:
+                        n = int(raw_n)
+                        if n < 0:
+                            raise ValueError
+                    except ValueError:
+                        self._send_json(400, {
+                            "error": "n must be a non-negative integer"})
+                        return
+                    doc["exemplars"] = doc["exemplars"][:n]
+                self._send_json(200, doc)
+
             def do_GET(self):
                 parsed = urllib.parse.urlparse(self.path)
                 path = parsed.path
@@ -103,11 +145,12 @@ class SchedulerServer:
                 if path == "/healthz":
                     self._send(200, "ok")
                 elif path == "/metrics":
-                    if sched.metrics is None:
-                        self._send(200, "")
-                    else:
-                        self._send(200, sched.metrics.expose_text(),
-                                   "text/plain; version=0.0.4")
+                    # Prometheus text exposition format 0.0.4 content
+                    # type either way (an empty registry is still a
+                    # valid scrape)
+                    body = ("" if sched.metrics is None
+                            else sched.metrics.expose_text())
+                    self._send(200, body, "text/plain; version=0.0.4")
                 elif path == "/configz":
                     cfg = sched.config
                     doc = asdict(cfg) if is_dataclass(cfg) else vars(cfg)
@@ -116,6 +159,8 @@ class SchedulerServer:
                     self._flightz(query)
                 elif path == "/debug/explain":
                     self._explain(query)
+                elif path == "/debug/slo":
+                    self._slo(query)
                 else:
                     self._send(404, "not found")
 

@@ -38,7 +38,7 @@ class Counter:
             return self._vals.get(labels, 0.0)
 
     def expose(self) -> List[str]:
-        out = [f"# HELP {self.name} {self.help}",
+        out = [f"# HELP {self.name} {_escape_help(self.help)}",
                f"# TYPE {self.name} counter"]
         with self._lock:
             items = sorted(self._vals.items())
@@ -59,7 +59,7 @@ class Gauge(Counter):
         super().inc(*labels, amount=-1.0)
 
     def expose(self) -> List[str]:
-        out = [f"# HELP {self.name} {self.help}",
+        out = [f"# HELP {self.name} {_escape_help(self.help)}",
                f"# TYPE {self.name} gauge"]
         with self._lock:
             items = sorted(self._vals.items())
@@ -112,7 +112,7 @@ class Histogram:
         return self.buckets[-1]
 
     def expose(self) -> List[str]:
-        out = [f"# HELP {self.name} {self.help}",
+        out = [f"# HELP {self.name} {_escape_help(self.help)}",
                f"# TYPE {self.name} histogram"]
         with self._lock:
             snapshot = sorted((k, list(v), self._sums[k])
@@ -130,10 +130,25 @@ class Histogram:
         return out
 
 
+def _escape_label(value) -> str:
+    """Prometheus text-format label-value escaping (exposition format
+    spec): backslash, double-quote and newline must be escaped or a
+    label value containing any of them corrupts the whole scrape."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    """# HELP escaping per the exposition format: backslash and newline
+    (quotes are legal in help text)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt(names, values) -> str:
     if not names:
         return ""
-    pairs = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    pairs = ",".join(f'{n}="{_escape_label(v)}"'
+                     for n, v in zip(names, values))
     return "{" + pairs + "}"
 
 
@@ -198,14 +213,21 @@ class SchedulerMetrics:
             f"{p}_pod_scheduling_attempts",
             "Number of attempts to successfully schedule a pod.",
             buckets=(1, 2, 4, 8, 16)))
+        # observed by framework/runtime.py at the HOST extension points
+        # that run once per pod per cycle (PreFilter, PostFilter, Reserve,
+        # Permit, PreBind, Bind, PostBind) — NOT the per-(pod, node)
+        # Filter loop, whose per-call observe would poison the hot path.
+        # The reference's plugin_execution_duration_seconds (per-plugin,
+        # 10% sampled) is deliberately NOT ported: host plugins here are
+        # the thin residue of a batched device design, per-plugin wall
+        # time is meaningless for the jitted families (one fused program
+        # serves every plugin), and per-plugin ATTRIBUTION is already
+        # served losslessly by the decision audit +
+        # scheduler_framework_rejections_total{plugin}.
         self.framework_extension_point_duration = r(Histogram(
             f"{p}_framework_extension_point_duration_seconds",
             "Latency for running all plugins of a specific extension point.",
             ("extension_point", "status")))
-        self.plugin_execution_duration = r(Histogram(
-            f"{p}_plugin_execution_duration_seconds",
-            "Duration for running a plugin at a specific extension point.",
-            ("plugin", "extension_point", "status")))
         self.queue_incoming_pods = r(Counter(
             f"{p}_queue_incoming_pods_total",
             "Number of pods added to scheduling queues by event and queue type.",
@@ -213,6 +235,8 @@ class SchedulerMetrics:
         self.pending_pods = r(Gauge(
             f"{p}_pending_pods",
             "Number of pending pods, by the queue type.", ("queue",)))
+        # observed by preemption.py: victims per committed preemption
+        # (at _commit_victims) and eligible pods served per wave
         self.preemption_victims = r(Histogram(
             f"{p}_preemption_victims", "Number of selected preemption victims",
             buckets=(1, 2, 4, 8, 16, 32, 64)))
@@ -222,6 +246,8 @@ class SchedulerMetrics:
         self.cache_size = r(Gauge(
             f"{p}_scheduler_cache_size",
             "Number of nodes, pods, and assumed pods in the cache.", ("type",)))
+        # observed by framework/runtime.py wait_on_permit, only for pods
+        # that actually entered a Wait (result: allowed/rejected/timeout)
         self.permit_wait_duration = r(Histogram(
             f"{p}_permit_wait_duration_seconds",
             "Duration of waiting on permit.", ("result",)))

@@ -349,6 +349,15 @@ class FlightRecorder:
         if recs:
             doc["total_s"] = round(max((r.t1 or r.t0) for r in recs)
                                    - t_base, 3)
+        # per-pod latency meta (utils/slo.py): when the SLO tracker is
+        # armed alongside the recorder, the pipeline doc carries the
+        # per-stage quantiles + shares so traceview can print the "SLO:"
+        # digest from the committed artifact alone
+        from . import slo as _slo
+        trk = _slo.tracker()
+        if trk is not None:
+            doc["slo"] = {"stages": trk.stage_quantiles(),
+                          "shares": trk.shares()}
         return doc
 
     @staticmethod

@@ -1,0 +1,438 @@
+"""Per-pod latency SLO layer (kubetpu/utils/slo.py): quantile-sketch
+correctness vs numpy.percentile, bounded memory, the disarmed
+zero-lock hot-path contract, the /debug/slo endpoint, exemplar
+linkage to the flight recorder + decision audit, the armed-vs-disarmed
+placement parity golden, and the /metrics exposition hardening that
+rides this PR (label escaping, 0.0.4 content type)."""
+import json
+import math
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubetpu.apis.config import (KubeSchedulerConfiguration,
+                                 KubeSchedulerProfile)
+from kubetpu.client.store import ClusterStore
+from kubetpu.harness import hollow
+from kubetpu.scheduler import Scheduler
+from kubetpu.server import SchedulerServer
+from kubetpu.utils import slo as uslo
+from kubetpu.utils import trace as utrace
+from kubetpu.utils.metrics import Counter, Histogram, SchedulerMetrics
+from kubetpu.utils.slo import (BUCKET_EDGES, BUCKET_RATIO, QuantileSketch,
+                               SloTracker)
+
+
+@pytest.fixture
+def slo():
+    """Armed tracker; always disarmed on exit (module-global, like the
+    flight recorder's fixture)."""
+    uslo.disarm_slo_tracker()
+    trk = uslo.arm_slo_tracker(max_exemplars=4)
+    try:
+        yield trk
+    finally:
+        uslo.disarm_slo_tracker()
+
+
+@pytest.fixture
+def flight():
+    utrace.disarm_flight_recorder()
+    fr = utrace.arm_flight_recorder(capacity=8)
+    try:
+        yield fr
+    finally:
+        utrace.disarm_flight_recorder()
+
+
+def _drain(sched):
+    outs = []
+    while True:
+        got = sched.schedule_pending(timeout=0.0)
+        if not got:
+            break
+        outs.extend(got)
+    return outs
+
+
+def _world(n_nodes=2, n_pods=6, batch=8, metrics=None, infeasible=False):
+    store = ClusterStore()
+    for n in hollow.make_nodes(n_nodes):
+        store.add(n)
+    sched = Scheduler(store, config=KubeSchedulerConfiguration(
+        profiles=[KubeSchedulerProfile()], batch_size=batch),
+        async_binding=False, metrics=metrics)
+    for p in hollow.make_pods(n_pods):
+        store.add(p)
+    if infeasible:
+        store.add(hollow.make_pod("too-big", cpu_milli=999999))
+    return store, sched
+
+
+# ------------------------------------------------------------------ sketch
+
+
+def test_sketch_matches_numpy_percentile_within_one_bucket():
+    """Property: on randomized latency draws, every reported quantile is
+    within one log-bucket width of the exact order statistic the sketch
+    targets (rank ceil(q*n)), and within two widths of numpy's default
+    interpolated percentile."""
+    rng = np.random.default_rng(42)
+    for scale in (2e-3, 0.05, 3.0):
+        draws = np.sort(rng.lognormal(mean=math.log(scale), sigma=1.2,
+                                      size=2000))
+        sk = QuantileSketch()
+        for v in rng.permutation(draws):
+            sk.observe(float(v))
+        n = len(draws)
+        for q in (0.5, 0.9, 0.99, 0.999):
+            est = sk.quantile(q)
+            exact = float(draws[min(max(math.ceil(q * n), 1), n) - 1])
+            # one bucket width around the targeted order statistic
+            assert exact <= est * (1 + 1e-9)
+            assert est <= exact * BUCKET_RATIO * (1 + 1e-9)
+            # and sanity vs numpy's interpolated default
+            interp = float(np.percentile(draws, q * 100))
+            assert interp / BUCKET_RATIO ** 2 <= est \
+                <= interp * BUCKET_RATIO ** 2
+
+
+def test_sketch_edge_cases():
+    sk = QuantileSketch()
+    assert sk.quantile(0.99) == 0.0
+    sk.observe(0.0)                       # below the first edge
+    sk.observe(1e9)                       # past the last edge (overflow)
+    assert sk.total == 2
+    assert sk.quantile(0.999) == pytest.approx(1e9)   # clamped to max
+    d = sk.to_dict()
+    assert d["count"] == 2 and d["max_s"] == pytest.approx(1e9)
+
+
+def test_bounded_memory_wrap():
+    """100k observations across stages leave the tracker at a fixed
+    footprint: one [len(edges)+1] count vector per stage and at most
+    max_exemplars exemplars (worst-e2e kept, sorted descending)."""
+    trk = SloTracker(max_exemplars=4)
+    rng = np.random.default_rng(0)
+    for i in range(10000):
+        e2e = float(rng.uniform(0.001, 10.0))
+        trk.observe_pod({"queue_wait": e2e / 3, "bind": e2e / 5,
+                         "e2e": e2e},
+                        pod=f"p{i}", namespace="default", uid=f"u{i}",
+                        attempts=1, cycle=i)
+    doc = trk.to_dict()
+    assert doc["pods"] == 10000
+    assert doc["stages"]["e2e"]["count"] == 10000
+    for st in doc["stages"].values():
+        assert st["count"] == 10000
+    ex = doc["exemplars"]
+    assert len(ex) == 4
+    assert [e["e2e_s"] for e in ex] == sorted(
+        (e["e2e_s"] for e in ex), reverse=True)
+    # the retained exemplars are genuinely the worst seen
+    assert min(e["e2e_s"] for e in ex) > 9.0
+    # fixed sketch footprint
+    for sk in trk._sketches.values():
+        assert sk.counts.shape == (len(BUCKET_EDGES) + 1,)
+    # shares: over stages only, e2e excluded, summing to ~1
+    assert "e2e" not in doc["shares"]
+    assert sum(doc["shares"].values()) == pytest.approx(1.0, abs=0.01)
+
+
+def test_zero_exemplars_is_quantiles_only():
+    """KUBETPU_SLO_EXEMPLARS=0 (quantiles only) must not crash the first
+    observation — the capacity check short-circuits on an empty list."""
+    trk = SloTracker(max_exemplars=0)
+    trk.observe_pod({"bind": 0.01, "e2e": 0.5}, pod="p", uid="u")
+    trk.observe_pod({"bind": 0.02, "e2e": 0.7}, pod="q", uid="v")
+    doc = trk.to_dict()
+    assert doc["pods"] == 2 and doc["exemplars"] == []
+    assert doc["stages"]["e2e"]["count"] == 2
+
+
+# --------------------------------------------------------- scheduling path
+
+
+def test_bound_pods_yield_stage_vectors(slo):
+    store, sched = _world()
+    try:
+        outs = _drain(sched)
+        bound = sum(1 for o in outs if o.node)
+        assert bound == 6
+        doc = slo.to_dict()
+        assert doc["pods"] == 6
+        stages = doc["stages"]
+        for name in ("queue_wait", "backoff", "cycle_wait", "dispatch",
+                     "device", "commit", "bind", "e2e"):
+            assert stages[name]["count"] == 6, name
+        # no meta keys leaked into the sketches
+        assert not any(k.startswith("_") for k in stages)
+        # e2e covers the stage pipeline for each pod: its p999 (max) is
+        # at least the bind p999 and at least queue_wait p999
+        assert stages["e2e"]["max_s"] >= stages["bind"]["max_s"] - 1e-9
+        ex = doc["exemplars"]
+        assert ex and all(e["outcome"] == "bound" for e in ex)
+        assert all(e["attempts"] >= 1 for e in ex)
+        assert all(set(e["stages_s"]) == {"queue_wait", "backoff",
+                                          "cycle_wait", "dispatch",
+                                          "device", "commit", "bind"}
+                   for e in ex)
+    finally:
+        sched.close()
+
+
+def test_exemplar_links_to_flight_record_and_audit(flight, slo):
+    store, sched = _world(batch=2)   # several cycles
+    try:
+        _drain(sched)
+        seqs = {c.seq for c in flight.cycles()}
+        ex = slo.exemplars()
+        assert ex
+        for e in ex:
+            # the exemplar's flight_seq names a real cycle record in the
+            # recorder's ring (capacity 8 > cycles here, nothing shed)
+            assert e["flight_seq"] in seqs
+            # ...and the decision audit can answer /debug/explain for it
+            d = sched.decisions.get(e["pod"], namespace=e["namespace"])
+            assert d is not None and d.outcome == "scheduled"
+            assert e["explain"].startswith("/debug/explain?pod=")
+    finally:
+        sched.close()
+
+
+def test_unresolvable_pod_recorded_once(slo):
+    """A terminally-infeasible pod that keeps retrying is recorded into
+    the sketches ONCE, not once per failing cycle — re-recording every
+    retry would multi-count it and let churn dominate the e2e p99.
+    (A node-selector mismatch is device-UNRESOLVABLE; plain resource
+    pressure stays resolvable — preemption may help it.)"""
+    store, sched = _world(n_pods=2)
+    nowhere = hollow.make_pod("nowhere")
+    nowhere.spec.node_selector = {"no-such-label": "x"}
+    store.add(nowhere)
+    try:
+        _drain(sched)
+        # force several retry cycles: each cluster event reactivates the
+        # unschedulable pod and it fails unresolvable again
+        for _ in range(3):
+            sched.queue.move_all_to_active_or_backoff_queue("test")
+            _drain(sched)
+        doc = slo.to_dict()
+        assert doc["unresolvable"] == 1
+        assert doc["pods"] == 2 + 1   # 2 bound + ONE unresolvable vector
+        ex_unres = [e for e in slo.exemplars()
+                    if e["outcome"] == "unresolvable"]
+        assert len(ex_unres) <= 1
+    finally:
+        sched.close()
+
+
+def test_disarmed_hot_path_is_noop(monkeypatch):
+    """Tracker disarmed: a full scheduling cycle (with failures) must
+    never construct an SloTracker, observe a sketch, or build a stage
+    vector — the zero-new-locks contract, enforced with the same
+    poison-monkeypatch pattern as tests/test_flightrecorder.py."""
+    uslo.disarm_slo_tracker()
+
+    def boom(*a, **kw):
+        raise AssertionError("hot path touched the disarmed SLO layer")
+
+    monkeypatch.setattr(uslo.SloTracker, "__init__", boom)
+    monkeypatch.setattr(uslo.SloTracker, "observe_pod", boom)
+    monkeypatch.setattr(uslo.QuantileSketch, "observe", boom)
+    monkeypatch.setattr(Scheduler, "_slo_prefix", boom)
+
+    store, sched = _world(infeasible=True)
+    try:
+        outs = _drain(sched)
+        assert sum(1 for o in outs if o.node) == 6
+        # disarmed pops never stamp the SLO pop time
+        assert all(o.pod.metadata.name for o in outs)
+    finally:
+        sched.close()
+
+
+def test_golden_world_parity_armed_vs_disarmed():
+    """Arming SLO tracking changes ZERO placements: the same
+    deterministic world drained armed and disarmed must bind every pod
+    to the same node."""
+    def run(arm):
+        uslo.disarm_slo_tracker()
+        if arm:
+            uslo.arm_slo_tracker()
+        try:
+            store, sched = _world(n_nodes=3, n_pods=12, batch=4,
+                                  infeasible=True)
+            try:
+                outs = _drain(sched)
+                return sorted((o.pod.metadata.name, o.node) for o in outs)
+            finally:
+                sched.close()
+        finally:
+            uslo.disarm_slo_tracker()
+
+    disarmed = run(False)
+    armed = run(True)
+    assert armed == disarmed
+    assert sum(1 for _, node in armed if node) == 12
+
+
+# ------------------------------------------------------------------- HTTP
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}") as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def test_debug_slo_http_roundtrip(slo):
+    store, sched = _world()
+    srv = SchedulerServer(sched, port=0)
+    port = srv.start()
+    try:
+        _drain(sched)
+        code, doc = _get(port, "/debug/slo")
+        assert code == 200 and doc["armed"] is True
+        assert doc["pods"] == 6
+        assert doc["stages"]["e2e"]["count"] == 6
+        assert {"p50_s", "p90_s", "p99_s", "p999_s"} <= set(
+            doc["stages"]["e2e"])
+        assert doc["shares"] and doc["exemplars"]
+
+        code, doc = _get(port, "/debug/slo?stage=bind&n=1")
+        assert code == 200
+        assert set(doc["stages"]) == {"bind"}
+        assert len(doc["exemplars"]) == 1
+
+        code, doc = _get(port, "/debug/slo?stage=no-such-stage")
+        assert code == 400 and "unknown stage" in doc["error"]
+
+        code, doc = _get(port, "/debug/slo?n=not-a-number")
+        assert code == 400 and "error" in doc
+    finally:
+        srv.stop()
+        sched.close()
+
+
+def test_debug_slo_disarmed_404():
+    uslo.disarm_slo_tracker()
+    store, sched = _world(n_pods=0)
+    srv = SchedulerServer(sched, port=0)
+    port = srv.start()
+    try:
+        code, doc = _get(port, "/debug/slo")
+        assert code == 404 and doc["armed"] is False
+    finally:
+        srv.stop()
+        sched.close()
+
+
+# -------------------------------------------------- /metrics hardening
+
+
+def test_metrics_label_escaping_and_histogram_conventions():
+    c = Counter("t_total", 'help with "quotes"\nand newline',
+                ("reason",))
+    c.inc('bad "value" \\ with\nnewline')
+    lines = c.expose()
+    assert lines[0] == 't_total help with "quotes"\\nand newline' \
+        .join(["# HELP ", ""]) or lines[0].startswith("# HELP t_total")
+    assert "\n" not in lines[0]
+    body = "\n".join(lines)
+    assert '\\"value\\"' in body and "\\\\" in body and "\\n" in body
+    h = Histogram("d_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(50.0)
+    text = "\n".join(h.expose())
+    assert 'le="+Inf"} 2' in text
+    assert "d_seconds_sum 50.05" in text
+    assert "d_seconds_count 2" in text
+    assert "# TYPE d_seconds histogram" in text
+
+
+def test_metrics_content_type_and_exposition():
+    m = SchedulerMetrics()
+    store, sched = _world(metrics=m)
+    srv = SchedulerServer(sched, port=0)
+    port = srv.start()
+    try:
+        _drain(sched)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics") as r:
+            assert r.status == 200
+            assert r.headers.get("Content-Type").startswith(
+                "text/plain; version=0.0.4")
+            body = r.read().decode()
+        assert "# HELP scheduler_binding_duration_seconds" in body
+        assert "# TYPE scheduler_binding_duration_seconds histogram" in body
+        assert 'scheduler_binding_duration_seconds_bucket{le="+Inf"} 6' \
+            in body
+        # the extension-point histogram is now observed on the commit
+        # path (Reserve/Permit/PreBind/Bind/PostBind per bound pod)
+        for point in ("Reserve", "Permit", "PreBind", "Bind", "PostBind"):
+            assert m.framework_extension_point_duration.count(
+                point, "Success") == 6, point
+        assert m.framework_extension_point_duration.count(
+            "PreFilter", "Success") == 6
+    finally:
+        srv.stop()
+        sched.close()
+
+
+def test_permit_wait_and_preemption_metrics_wired():
+    """The previously-dormant metrics observe through the real seams:
+    permit_wait via a Wait permit plugin, preemption attempts/victims
+    via a priority pod preempting a filler."""
+    from kubetpu.framework.interface import Code, PermitPlugin, Status
+
+    class WaitingPermit(PermitPlugin):
+        def name(self):
+            return "WaitingPermit"
+
+        def permit(self, state, pod, node_name):
+            return Status(Code.WAIT), 0.05   # times out -> rejected
+
+    m = SchedulerMetrics()
+    store = ClusterStore()
+    store.add(hollow.make_node("n1", cpu_milli=1000))
+    from kubetpu.plugins.intree import new_in_tree_registry
+    registry = new_in_tree_registry()
+    registry["WaitingPermit"] = lambda args, fw: WaitingPermit()
+    from kubetpu.apis.config import PluginSet, Plugin, Plugins
+    prof = KubeSchedulerProfile(plugins=Plugins(
+        permit=PluginSet(enabled=[Plugin(name="WaitingPermit")])))
+    sched = Scheduler(store, config=KubeSchedulerConfiguration(
+        profiles=[prof], batch_size=4), registry=registry,
+        async_binding=False, metrics=m)
+    try:
+        store.add(hollow.make_pod("w1", cpu_milli=100))
+        _drain(sched)
+        assert m.permit_wait_duration.count("rejected") == 1
+    finally:
+        sched.close()
+
+    # preemption: fill the node, then a higher-priority pod evicts
+    m2 = SchedulerMetrics()
+    store2 = ClusterStore()
+    store2.add(hollow.make_node("n1", cpu_milli=1000))
+    sched2 = Scheduler(store2, config=KubeSchedulerConfiguration(
+        profiles=[KubeSchedulerProfile()], batch_size=4),
+        async_binding=False, metrics=m2)
+    try:
+        filler = hollow.make_pod("filler", cpu_milli=900)
+        store2.add(filler)
+        _drain(sched2)
+        high = hollow.make_pod("high", cpu_milli=900)
+        high.spec.priority = 100
+        store2.add(high)
+        _drain(sched2)
+        assert m2.preemption_attempts.value() >= 1
+        assert m2.preemption_victims.count() >= 1
+    finally:
+        sched2.close()

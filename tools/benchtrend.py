@@ -1,0 +1,331 @@
+"""Bench-trend tooling: the committed BENCH_r*.json trajectory as a
+per-case trend table, with per-stage regression ATTRIBUTION.
+
+The repo commits one bench artifact per PR round (``BENCH_r01.json`` ..,
+plus the ``MULTICHIP_r*.json`` mesh runs); each carries the bench.py
+``detail`` document — and, since the SLO layer (kubetpu/utils/slo.py),
+a per-case ``latency`` block (``pod_e2e_p50/p90/p99_s`` +
+``stage_shares``).  This tool reads that trajectory, optionally appends
+a fresh run (``--run`` pointing at a BENCH_OUT-format file), and prints:
+
+  * a per-case trend table (pods/s per round, with the round-over-round
+    delta), and
+  * for every case whose throughput regressed beyond the threshold,
+    WHICH STAGE's latency share grew — the stage_shares diff when both
+    rounds carry the latency block, the host_share/device_wait split
+    otherwise.
+
+``--check`` is the CI mode (tools/ci_lint.sh): nonzero exit when a
+committed artifact is schema-INCOMPATIBLE (a case present but
+non-numeric where the trend table needs numbers) or when the newest
+parseable round regresses beyond the NORTHSTAR.json gate (bench.py's
+northstar_gate — the same floors/ceilings BENCH_GATE=1 enforces).
+Artifacts whose detail cannot be recovered (e.g. a tail-truncated
+capture) are reported and skipped, never a hard failure — the committed
+history is immutable.
+
+Usage:
+  python -m tools.benchtrend [--glob 'BENCH_r*.json'] [--run FRESH.json]
+                             [--check] [--threshold 0.1]
+"""
+from __future__ import annotations
+
+import argparse
+import glob as globmod
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# dotted case -> the numeric field the trend table tracks (first match
+# wins; cases carrying neither are skipped)
+THROUGHPUT_KEYS = ("pods_per_sec",)
+SECONDS_KEYS = ("e2e_best_s", "e2e_s", "restart_s", "cold_restart_s")
+
+
+def _find_detail(doc) -> Optional[Dict[str, Any]]:
+    """Recover the bench ``detail`` document from any committed artifact
+    shape: a BENCH_OUT file ({"headline", "detail"}), a raw
+    {"detail": ...} stderr line, or the round-capture wrapper
+    ({"parsed": {"detail": ...}, "tail": "..."}).  Falls back to
+    scanning the captured tail for a parseable {"detail": ...} line
+    (r05's tail was cut mid-line — that one stays unrecoverable and the
+    caller reports it)."""
+    if not isinstance(doc, dict):
+        return None
+    if isinstance(doc.get("detail"), dict):
+        return doc["detail"]
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict) and isinstance(parsed.get("detail"), dict):
+        return parsed["detail"]
+    tail = doc.get("tail")
+    if isinstance(tail, str):
+        for line in tail.splitlines():
+            idx = line.find('{"detail"')
+            if idx < 0:
+                continue
+            try:
+                cand = json.loads(line[idx:])
+            except ValueError:
+                continue
+            if isinstance(cand.get("detail"), dict):
+                return cand["detail"]
+    return None
+
+
+def load_round(path: str) -> Dict[str, Any]:
+    name = os.path.basename(path)
+    for suffix in (".json",):
+        if name.endswith(suffix):
+            name = name[: -len(suffix)]
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return {"round": name, "detail": None,
+                "note": f"unreadable ({e.__class__.__name__})"}
+    detail = _find_detail(doc)
+    if detail is None:
+        return {"round": name, "detail": None,
+                "note": "no parseable detail document "
+                        "(truncated capture or non-bench artifact)"}
+    return {"round": name, "detail": detail, "note": ""}
+
+
+def flatten_cases(detail: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    """Dotted case name -> case dict for every bench case that carries a
+    trendable number (top level, chain_drain.* and northstar.*)."""
+    out: Dict[str, Dict[str, Any]] = {}
+
+    def visit(prefix: str, node, depth: int) -> None:
+        if not isinstance(node, dict):
+            return
+        has_metric = any(isinstance(node.get(k), (int, float))
+                         for k in THROUGHPUT_KEYS + SECONDS_KEYS)
+        if has_metric:
+            out[prefix] = node
+            return
+        if depth >= 2:
+            return
+        for k, v in node.items():
+            if isinstance(v, dict):
+                visit(f"{prefix}.{k}" if prefix else k, v, depth + 1)
+
+    visit("", detail, 0)
+    return out
+
+
+def case_value(case: Dict[str, Any],
+               unit: str = "") -> Tuple[Optional[float], str]:
+    """(value, unit) — throughput preferred, seconds as fallback.  Pass
+    ``unit`` to pin the extraction to one unit (rows must not mix
+    pods/s from one round with seconds from another)."""
+    if unit in ("", "pods/s"):
+        for k in THROUGHPUT_KEYS:
+            v = case.get(k)
+            if isinstance(v, (int, float)):
+                return float(v), "pods/s"
+    if unit in ("", "s"):
+        for k in SECONDS_KEYS:
+            v = case.get(k)
+            if isinstance(v, (int, float)):
+                return float(v), "s"
+    return None, ""
+
+
+def row_unit(cases: List[Dict[str, Any]]) -> str:
+    """One unit per trend row: pods/s when any round carries it."""
+    for case in cases:
+        if any(isinstance(case.get(k), (int, float))
+               for k in THROUGHPUT_KEYS):
+            return "pods/s"
+    return "s"
+
+
+def attribute_regression(prev: Dict[str, Any],
+                         cur: Dict[str, Any]) -> str:
+    """Name the stage whose share of per-pod latency grew most between
+    two rounds of one case — the SLO layer's stage_shares when both
+    carry it, the host/device split otherwise."""
+    ps = (prev.get("latency") or {}).get("stage_shares") or {}
+    cs = (cur.get("latency") or {}).get("stage_shares") or {}
+    if ps and cs:
+        deltas = {k: cs.get(k, 0.0) - ps.get(k, 0.0)
+                  for k in set(ps) | set(cs)}
+        stage = max(deltas, key=lambda k: deltas[k])
+        if deltas[stage] > 0:
+            return (f"stage '{stage}' share grew "
+                    f"{ps.get(stage, 0.0):.2f} -> {cs.get(stage, 0.0):.2f}"
+                    f" (+{deltas[stage]:.2f})")
+        return "no stage share grew (uniform slowdown)"
+    hp, hc = prev.get("host_share"), cur.get("host_share")
+    if isinstance(hp, (int, float)) and isinstance(hc, (int, float)):
+        side = "host" if hc > hp else "device"
+        return (f"no latency block on both sides; host_share "
+                f"{hp:.2f} -> {hc:.2f} ({side} side grew)")
+    return "no latency/host_share data to attribute"
+
+
+def build_trend(rounds: List[Dict[str, Any]],
+                threshold: float) -> Tuple[List[str], List[str], List[str]]:
+    """(table lines, attribution lines, schema errors)."""
+    usable = [r for r in rounds if r["detail"] is not None]
+    per_round = [(r["round"], flatten_cases(r["detail"])) for r in usable]
+    names: List[str] = []
+    for _, cases in per_round:
+        for c in cases:
+            if c not in names:
+                names.append(c)
+    errors: List[str] = []
+    width = max([len(n) for n in names] + [4])
+    header = f"{'case':<{width}}  " + "  ".join(
+        f"{rn[-12:]:>12}" for rn, _ in per_round) + "  unit"
+    lines = [header, "-" * len(header)]
+    attributions: List[str] = []
+    for name in names:
+        present = [cases[name] for _, cases in per_round if name in cases]
+        unit = row_unit(present)
+        vals: List[Optional[float]] = []
+        series: List[Tuple[str, Dict[str, Any], float]] = []
+        for rn, cases in per_round:
+            case = cases.get(name)
+            if case is None:
+                vals.append(None)
+                continue
+            v, _ = case_value(case, unit)
+            if v is None:
+                if case_value(case)[0] is None:
+                    errors.append(
+                        f"{rn}: case {name!r} present but carries no "
+                        f"numeric "
+                        f"{'/'.join(THROUGHPUT_KEYS + SECONDS_KEYS)} field")
+                vals.append(None)
+                continue
+            vals.append(v)
+            series.append((rn, case, v))
+        cells = "  ".join("            " if v is None else f"{v:>12.1f}"
+                          for v in vals)
+        lines.append(f"{name:<{width}}  {cells}  {unit}")
+        # round-over-round regression attribution on adjacent PRESENT
+        # rounds (throughput: lower is worse; seconds: higher is worse)
+        for (rn0, c0, v0), (rn1, c1, v1) in zip(series, series[1:]):
+            if not v0:
+                continue
+            worse = (v1 < v0 * (1 - threshold) if unit == "pods/s"
+                     else v1 > v0 * (1 + threshold))
+            if worse:
+                attributions.append(
+                    f"{name}: {rn0} -> {rn1}: {v0:.1f} -> {v1:.1f} {unit}; "
+                    + attribute_regression(c0, c1))
+    return lines, attributions, errors
+
+
+def northstar_check(rounds: List[Dict[str, Any]]
+                    ) -> Tuple[List[str], str]:
+    """Run bench.py's NORTHSTAR gate against the newest parseable
+    round's detail — the same floors/ceilings BENCH_GATE=1 enforces,
+    minus the live-run-only bit-identity checks.  Returns (failures,
+    coverage line): the coverage line says HOW MANY gate entries the
+    round actually carried metrics for, so a PASS where every entry was
+    skipped reads as 'gate not evaluated', never as a clean bill."""
+    latest = next((r for r in reversed(rounds) if r["detail"] is not None),
+                  None)
+    if latest is None:
+        return [], ""
+    if REPO_ROOT not in sys.path:
+        sys.path.insert(0, REPO_ROOT)
+    try:
+        from bench import _gate_path, northstar_gate
+    except ImportError:
+        return [], ""
+    path = os.path.join(REPO_ROOT, "NORTHSTAR.json")
+    # the trend check gates committed HISTORY, where placements_match
+    # booleans may predate the oracle cases — only gate numeric drift
+    detail = {k: v for k, v in latest["detail"].items()
+              if k not in ("warm_restart", "backend_compare")}
+    detail["warm_restart"] = {
+        k: v for k, v in (latest["detail"].get("warm_restart") or {}).items()
+        if k != "placements_match"}
+    failures = northstar_gate(detail, path=path)
+    try:
+        with open(path) as f:
+            gate = json.load(f).get("gate") or {}
+    except (OSError, ValueError):
+        gate = {}
+    evaluated = [k for k, ref in gate.items()
+                 if _gate_path(detail, ref.get("path", k)) is not None]
+    coverage = (f"NORTHSTAR gate on {latest['round']}: "
+                f"{len(evaluated)}/{len(gate)} entries evaluated"
+                + ("" if evaluated or not gate else
+                   " — gate NOT exercised (round carries no gated "
+                   "metrics; floors/ceilings bite on BENCH_GATE=1 "
+                   "live runs)"))
+    return [f"{latest['round']}: {f}" for f in failures], coverage
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="benchtrend",
+        description="per-case trend table + regression attribution over "
+                    "the committed bench JSON trajectory")
+    ap.add_argument("--glob", default="BENCH_r*.json,MULTICHIP_r*.json",
+                    help="comma-separated globs, resolved in the repo "
+                         "root (default: the committed round captures)")
+    ap.add_argument("--run", default=None,
+                    help="a fresh BENCH_OUT-format JSON appended as the "
+                         "newest round")
+    ap.add_argument("--threshold", type=float, default=0.1,
+                    help="relative regression that triggers attribution "
+                         "(default 0.1)")
+    ap.add_argument("--check", action="store_true",
+                    help="CI mode: nonzero exit on schema-incompatible "
+                         "artifacts or NORTHSTAR-gate regressions")
+    args = ap.parse_args(argv)
+
+    paths: List[str] = []
+    for pat in args.glob.split(","):
+        pat = pat.strip()
+        if not pat:
+            continue
+        hits = globmod.glob(os.path.join(REPO_ROOT, pat)) or \
+            globmod.glob(pat)
+        paths.extend(sorted(hits))
+    rounds = [load_round(p) for p in paths]
+    if args.run:
+        rounds.append(load_round(args.run))
+
+    skipped = [r for r in rounds if r["detail"] is None]
+    for r in skipped:
+        print(f"note: {r['round']}: {r['note']}")
+    if not any(r["detail"] is not None for r in rounds):
+        print("no parseable bench rounds found")
+        return 1 if args.check else 0
+
+    lines, attributions, errors = build_trend(rounds, args.threshold)
+    print("\n".join(lines))
+    if attributions:
+        print()
+        print("regressions (beyond %.0f%%):" % (100 * args.threshold))
+        for a in attributions:
+            print("  " + a)
+    gate_failures, gate_coverage = northstar_check(rounds)
+    if gate_coverage:
+        print()
+        print(gate_coverage)
+    for f in gate_failures:
+        print("  " + f)
+    if args.check:
+        for e in errors:
+            print("schema error: " + e)
+        if errors or gate_failures:
+            return 1
+        print("benchtrend --check: PASS "
+              f"({sum(1 for r in rounds if r['detail'] is not None)} "
+              f"rounds, {len(skipped)} unparseable skipped)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,9 +17,11 @@ python -m tools.kubelint kubetpu/ --json
 # future refactor can't hide a violation behind an unrelated suppression.
 # The chaos registry rides the same pass: its fire counters are
 # guarded-by annotated and its decide/act split must never sleep or
-# raise under the lock (blocking-under-lock)
+# raise under the lock (blocking-under-lock).  The SLO tracker
+# (utils/slo.py) joins it: its sketch/exemplar state is guarded-by
+# annotated and observed from both the serving thread and binder pool
 python -m tools.kubelint kubetpu/utils/trace.py kubetpu/utils/decisions.py \
-	kubetpu/utils/chaos.py --rules concurrency --json
+	kubetpu/utils/chaos.py kubetpu/utils/slo.py --rules concurrency --json
 # explicit delta-family pass over the serving loop: the cycle path must
 # stay scatter-only (full-retensorize-in-loop), independent of any
 # unrelated suppression elsewhere in the tree
@@ -56,3 +58,14 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
 # adds zero locks and zero readbacks to the hot path).
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
 	tests/test_chaos.py -q -m 'not slow' -p no:cacheprovider
+# Per-pod latency SLO layer (utils/slo.py): quantile-sketch property vs
+# numpy.percentile, bounded memory, the disarmed zero-lock poison test,
+# /debug/slo round trip, exemplar->flight-record linkage, and the
+# armed-vs-disarmed placement-parity golden.
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
+	tests/test_slo.py -q -m 'not slow' -p no:cacheprovider
+# Bench-trend CI check (tools/benchtrend.py, pure JSON, no jax): the
+# committed BENCH_r*/MULTICHIP_r* trajectory must stay schema-compatible
+# with the trend tooling, and the newest parseable round must not
+# regress beyond the NORTHSTAR.json gate floors/ceilings.
+python -m tools.benchtrend --check

@@ -32,16 +32,21 @@ import bench  # noqa: E402
 from kubetpu.apis.config import (KubeSchedulerConfiguration,  # noqa: E402
                                  KubeSchedulerProfile)
 from kubetpu.scheduler import Scheduler  # noqa: E402
+from kubetpu.utils import slo as uslo  # noqa: E402
 from kubetpu.utils import trace as utrace  # noqa: E402
 
 
 def main():
     flight = utrace.arm_flight_recorder()
+    # the SLO tracker rides the captured drain so the committed pipeline
+    # doc carries the per-stage latency meta traceview digests ("SLO:")
+    slo = uslo.arm_slo_tracker()
     sched = None
     for warm in (False, True):
         if sched is not None:
             sched.close()
         flight.clear()
+        slo.clear()
         store, pending = bench.build_world(1000, 4096, 2)
         sched = Scheduler(store, config=KubeSchedulerConfiguration(
             profiles=[KubeSchedulerProfile()], batch_size=1024,

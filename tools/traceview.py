@@ -119,6 +119,33 @@ def delta_summary(spans: List[dict]) -> str:
             f"(rows p50 {p50}), {resyncs} resyncs")
 
 
+def slo_summary(doc) -> str:
+    """One-line per-pod latency digest under the stage table: per-stage
+    p50/p99 from the SLO block the pipeline doc (or a /debug/slo-merged
+    flightz dump) carries when the KUBETPU_SLO tracker was armed for the
+    run (kubetpu/utils/slo.py)."""
+    slo = doc.get("slo")
+    if not isinstance(slo, dict):
+        return ""
+    stages = slo.get("stages") or {}
+
+    def ms(v):
+        return f"{1000 * v:.1f}ms" if v < 1.0 else f"{v:.2f}s"
+
+    parts = []
+    order = ["e2e", "queue_wait", "backoff", "cycle_wait", "dispatch",
+             "device", "commit", "bind"]
+    for name in order + sorted(set(stages) - set(order)):
+        st = stages.get(name)
+        if not st or not st.get("count"):
+            continue
+        parts.append(f"{name} p50 {ms(st.get('p50_s', 0.0))} "
+                     f"p99 {ms(st.get('p99_s', 0.0))}")
+    if not parts:
+        return ""
+    return "SLO: " + " | ".join(parts)
+
+
 def auction_summary(doc) -> str:
     """One-line auction digest under the stage table: the per-cycle round
     HISTOGRAM (rounds -> cycles) plus the kernel-backend split, read from
@@ -199,6 +226,9 @@ def main(argv=None) -> int:
     auction = auction_summary(doc)
     if auction:
         print(auction)
+    slo = slo_summary(doc)
+    if slo:
+        print(slo)
     if not spans:
         return 0
     wall: Dict[int, float] = {}
