@@ -4,7 +4,7 @@
 
 .PHONY: help lint lock-graph test sanitize-test race-test flight-test \
 	delta-test census census-test aot aot-test pallas-test chaos-test \
-	slo-test trend trace bench
+	slo-test pipeline-test trend trace bench
 
 help:
 	@echo "kubetpu targets:"
@@ -54,6 +54,11 @@ help:
 	@echo "                      memory, disarmed zero-lock poison, /debug/slo"
 	@echo "                      round trip, exemplar links, armed-vs-disarmed"
 	@echo "                      placement parity"
+	@echo "  make pipeline-test  depth-k pipelined executor suite"
+	@echo "                      (kubetpu/pipeline.py): depth-parity"
+	@echo "                      placement goldens, gather-window gating on"
+	@echo "                      free ring slots, per-slot exemption"
+	@echo "                      accounting, chaos-at-depth scatter recovery"
 	@echo "  make trend          per-case bench trend table over the committed"
 	@echo "                      BENCH_r*.json trajectory with per-stage"
 	@echo "                      regression attribution (tools/benchtrend.py)"
@@ -143,6 +148,17 @@ chaos-test:
 slo-test:
 	JAX_PLATFORMS=cpu python -m pytest \
 		tests/test_slo.py -q -p no:cacheprovider
+
+# depth-k pipelined executor (kubetpu/pipeline.py): depth-parity
+# placement goldens, the gather-window/free-slot gate, ring exemption
+# accounting, ring-slot flight tags, and the chaos-at-depth scatter
+# recovery regressions that live next to the delta suite's chain-break
+# test
+pipeline-test:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_pipeline.py tests/test_chain.py -q -p no:cacheprovider
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_delta.py -q -k 'depth4 or pipelined' -p no:cacheprovider
 
 # bench trend table + regression attribution over the committed rounds
 trend:

@@ -181,7 +181,7 @@ def _rounds_hist(cycle_rounds):
 
 def run_mode(mode, n_nodes, n_pods, existing_per_node, repeats,
              mesh_shape=None, batch_cap=None, chain=None, ipa_heavy=False,
-             pipeline=False, kernel_backend="lax"):
+             pipeline=False, kernel_backend="lax", pipeline_depth=None):
     """One full e2e measurement: fresh store + scheduler per attempt; the
     first attempt pays XLA compiles (bounded by the persistent cache),
     later attempts reuse the in-process jit cache.  Pod counts above
@@ -189,11 +189,14 @@ def run_mode(mode, n_nodes, n_pods, existing_per_node, repeats,
     the serving loop's real shape."""
     from kubetpu.apis.config import (KubeSchedulerConfiguration,
                                      KubeSchedulerProfile)
+    from kubetpu.harness.perf import host_share
     from kubetpu.scheduler import Scheduler
 
     batch_cap = batch_cap or int(os.environ.get("BENCH_BATCH", "4096"))
     if chain is None:
         chain = os.environ.get("BENCH_CHAIN", "1") != "0"
+    if pipeline_depth is None:
+        pipeline_depth = 2          # the config default
 
     # compile vs cache-load split (PR 6 watchdog events, satellite of the
     # AOT PR): the jax.monitoring timer separates true XLA compile seconds
@@ -222,7 +225,8 @@ def run_mode(mode, n_nodes, n_pods, existing_per_node, repeats,
             profiles=[KubeSchedulerProfile()],
             batch_size=min(n_pods, batch_cap), mode=mode,
             mesh_shape=mesh_shape, chain_cycles=chain,
-            pipeline_cycles=pipeline, kernel_backend=kernel_backend)
+            pipeline_cycles=pipeline, kernel_backend=kernel_backend,
+            pipeline_depth=pipeline_depth)
         sched = Scheduler(store, config=cfg, async_binding=False)
         for p in pending:
             store.add(p)
@@ -255,7 +259,11 @@ def run_mode(mode, n_nodes, n_pods, existing_per_node, repeats,
             "cycle_p50_s": round(_percentile(cycle_times, 0.5), 3),
             "cycle_p99_s": round(_percentile(cycle_times, 0.99), 3),
             "device_wait_s": round(sched.device_wait_s, 3),
-            "host_share": round(1.0 - sched.device_wait_s / max(dt, 1e-9), 3),
+            "host_share": host_share(sched.device_wait_s, dt),
+            # the executor depth this case drained at (1 = synchronous;
+            # tools/benchtrend.py names depth changes when attributing
+            # cross-round deltas)
+            "pipeline_depth": pipeline_depth if pipeline else 1,
             # incremental tensorization (state/delta.py): rows the scatter
             # path updated per delta cycle + how often the blessed full
             # rebuild ran (last attempt's drain)
@@ -395,6 +403,13 @@ def gate_entries(detail, northstar=None):
     # node-flap storm throughput floor (the case has no warm repeat, so
     # the generous default min_frac from an empty spread applies)
     entry("node_flap.pods_per_sec", detail.get("node_flap"))
+    # depth-k executor floors: the deepest measured ring must keep its
+    # throughput (a regression here means the overlap stopped hiding
+    # prepare/commit time behind device execution)
+    pd = detail.get("pipeline_depth", {})
+    for dkey in sorted(k for k in pd
+                       if k.startswith("d") and k[1:].isdigit()):
+        entry(f"pipeline_depth.{dkey}.pods_per_sec", pd.get(dkey))
     # cold_restart_s CEILING (lower is better, unlike the throughput
     # floors): restart-to-first-placement with AOT artifacts shipped.
     # The failure mode this catches is categorical — artifacts stop
@@ -445,6 +460,13 @@ def northstar_gate(detail, path="NORTHSTAR.json"):
         failures.append(
             "backend_compare: pallas placements diverged from the lax "
             "oracle (bit-identity contract, ops/pallas_kernels.py)")
+    # ...and for the pipeline depths: depth-1 is the synchronous oracle
+    # the depth-k executor must reproduce bit-for-bit
+    if detail.get("pipeline_depth", {}).get("placements_match") is False:
+        failures.append(
+            "pipeline_depth: depth-k placements diverged from the "
+            "depth-1 synchronous drain (bit-identity contract, "
+            "kubetpu/pipeline.py)")
     try:
         with open(path) as f:
             doc = json.load(f)
@@ -520,6 +542,44 @@ def chain_drain_case(n_nodes, n_pods, existing_per_node):
     return out
 
 
+def pipeline_depth_case(n_nodes, n_pods, existing_per_node,
+                        depths=(1, 2, 4)):
+    """Depth-k pipelined executor (kubetpu/pipeline.py): the SAME
+    deterministic serial-chain-bound world — the multi-cycle chained gang
+    drain whose host_share motivated the refactor — drained once per
+    pipeline depth.  Placements must be BIT-IDENTICAL across depths
+    (every cycle dispatches against the previous cycle's speculative
+    chain or the committed cache, never a divergent state); under
+    BENCH_GATE a mismatch fails the run like warm_restart's
+    placements_match, with no recorded floor needed.  The per-depth
+    pods_per_sec / latency blocks record what the depth actually buys:
+    deeper rings hide more prepare/commit time behind device execution
+    (the stage_shares show which share shrank)."""
+    out = {"depths": list(depths)}
+    cap = max(256, n_pods // 8)
+    placements = {}
+    for depth in depths:
+        best, first, outcomes, sched, stats = run_mode(
+            "gang", n_nodes, n_pods, existing_per_node, repeats=1,
+            batch_cap=cap, chain=True, pipeline=True, pipeline_depth=depth)
+        d, pods_per_sec = mode_summary("gang", best, first, outcomes,
+                                       sched, stats)
+        d["pods_per_sec"] = round(pods_per_sec, 1)
+        d["ring_high_water"] = sched._pipeline.ring.high_water
+        placements[depth] = {o.pod.metadata.name: o.node for o in outcomes}
+        sched.close()
+        out[f"d{depth}"] = d
+    out["batch_cap"] = cap
+    base = placements[depths[0]]
+    out["placements_match"] = bool(base) and all(
+        placements[d] == base for d in depths)
+    base_s = out[f"d{depths[0]}"]["e2e_best_s"]
+    out["depth_speedup"] = {
+        f"d{d}": round(base_s / max(out[f"d{d}"]["e2e_best_s"], 1e-9), 3)
+        for d in depths[1:]}
+    return out
+
+
 def pv_heavy_case(n_nodes=1000, n_pods=2048):
     """PVC-heavy workload at >=1000 nodes (VERDICT r4 #4): every pod mounts
     a bound in-tree PV (zone-labeled, so VolumeZone really filters) plus a
@@ -531,6 +591,7 @@ def pv_heavy_case(n_nodes=1000, n_pods=2048):
     from kubetpu.api import types as api
     from kubetpu.client.store import ClusterStore
     from kubetpu.harness import hollow
+    from kubetpu.harness.perf import host_share
     from kubetpu.scheduler import Scheduler
     from kubetpu.apis.config import (KubeSchedulerConfiguration,
                                      KubeSchedulerProfile)
@@ -589,8 +650,8 @@ def pv_heavy_case(n_nodes=1000, n_pods=2048):
                 "e2e_best_s": round(dt, 3),
                 "scheduled": sum(1 for o in outcomes if o.node),
                 "device_wait_s": round(sched.device_wait_s, 3),
-                "host_share": round(1.0 - sched.device_wait_s
-                                    / max(dt, 1e-9), 3),
+                "host_share": host_share(sched.device_wait_s, dt),
+                "pipeline_depth": 1,
                 "pods_per_sec": round(len(outcomes) / dt, 1),
             }
     stats["repeat_raw_s"] = raw_s
@@ -664,6 +725,7 @@ def node_flap_case(n_nodes=256, n_pods=1024, waves=4, flap=24):
         "cycle_p99_s": round(_percentile(cycle_times, 0.99), 3),
         "device_wait_s": round(sched.device_wait_s, 3),
         "scheduled": scheduled,
+        "pipeline_depth": 1,
         "pods_per_sec": round(len(outcomes) / max(dt, 1e-9), 1),
         # the recovery-path telemetry this case exists to record
         "resync_count": sched.resync_count,
@@ -894,7 +956,8 @@ def rescore_case(n_pods=51200, n_nodes=10240, chunk=4096):
         store, pending = build_world(n_nodes, n_pods, existing_per_node=1)
         cfg = KubeSchedulerConfiguration(
             profiles=[KubeSchedulerProfile()], batch_size=chunk, mode="gang",
-            chain_cycles=True, pipeline_cycles=True)
+            chain_cycles=True, pipeline_cycles=True,
+            pipeline_depth=int(os.environ.get("BENCH_RESCORE_DEPTH", "2")))
         sched = Scheduler(store, config=cfg, async_binding=False)
         for p in pending:
             store.add(p)
@@ -932,6 +995,7 @@ def rescore_case(n_pods=51200, n_nodes=10240, chunk=4096):
             "cycle_p99_s": round(_percentile(cycle_times, 0.99), 3),
             "device_wait_s": round(sched.device_wait_s, 3),
             "device_tflop": round(sched.device_flops / 1e12, 3),
+            "pipeline_depth": cfg.pipeline_depth,
             "pods_per_sec": round(len(outcomes) / dt, 1),
             "scheduled": scheduled,
             "hbm_peak_bytes": int(mem.get("peak_bytes_in_use", 0)),
@@ -1125,6 +1189,13 @@ def main() -> None:
                                                      existing_per_node)
         except Exception as e:  # pragma: no cover - depends on device state
             detail["chain_drain"] = {"error": repr(e)}
+
+    if os.environ.get("BENCH_PIPELINE", "1") == "1" and mesh_shape is None:
+        try:
+            detail["pipeline_depth"] = pipeline_depth_case(
+                n_nodes, n_pods, existing_per_node)
+        except Exception as e:  # pragma: no cover - depends on device state
+            detail["pipeline_depth"] = {"error": repr(e)}
 
     if os.environ.get("BENCH_PV", "1") == "1" and mesh_shape is None:
         try:

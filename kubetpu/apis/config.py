@@ -160,23 +160,34 @@ class KubeSchedulerConfiguration:
     # restart (bench.py warm_restart_case, 1024-pod wave x 1000 nodes):
     # first cycle 0.36 s.
     prewarm_ladder: int = 2
-    # Double-buffered drain (gang + chain_cycles only): schedule_pending
+    # Pipelined drain (gang + chain_cycles only): schedule_pending
     # dispatches cycle k against the previous cycle's speculative on-device
-    # chained cluster BEFORE committing cycle k-1, so cycle k's device
+    # chained cluster BEFORE committing older cycles, so cycle k's device
     # execution overlaps both the commit loop of k-1 and the tensorize of
     # k+1 (SURVEY §7 "batched, donated, overlapped"; the reference's
     # analog is the bind goroutine, scheduler.go:628).  Outcomes therefore
-    # LAG one cycle: each schedule_pending call returns the PREVIOUS
-    # dispatched cycle's outcomes, and a final call with an empty queue
-    # flushes the last in-flight cycle.  A commit failure or an
-    # unaccounted store event discards the speculative dispatch and
-    # re-runs that cycle against a rebuilt snapshot; batches needing host
-    # filter masks (volume pods) serialize on the in-flight commit, so
-    # placements match the synchronous drain.  Known one-cycle lag: the
-    # nominated-pods overlay sees preemption nominations from cycle k-1
-    # only at cycle k+1 (nominations only shrink retry feasibility, never
+    # LAG up to pipeline_depth-1 cycles: each schedule_pending call
+    # returns previously dispatched cycles' outcomes, and final calls
+    # with an empty queue flush the in-flight ring one cycle per call.
+    # A commit failure or an unaccounted store event discards the
+    # speculative dispatches and re-runs those cycles against a rebuilt
+    # snapshot; batches needing host filter masks (volume pods)
+    # serialize on the in-flight commits, so placements match the
+    # synchronous drain.  Known bounded lag: the nominated-pods overlay
+    # sees preemption nominations from an in-flight cycle only once it
+    # commits (nominations only shrink retry feasibility, never
     # correctness of committed placements).
     pipeline_cycles: bool = False
+    # Depth of the pipelined executor's in-flight ring (kubetpu/
+    # pipeline.py): the maximum number of cycles in flight at once —
+    # prepare(k+1) overlaps device(k) and commit/bind(k-1).  1 = fully
+    # synchronous (every cycle commits before the next pops), 2 = the
+    # historical double-buffered chain (the default), higher depths park
+    # more dispatched-but-uncommitted cycles between schedule_pending
+    # calls.  Placements are bit-identical at every depth (the bench
+    # pipeline_depth case's gated contract).  Env override:
+    # KUBETPU_PIPELINE_DEPTH (an operator can re-depth a live fleet).
+    pipeline_depth: int = 2
 
     def profile_for(self, name: str) -> Optional[KubeSchedulerProfile]:
         for p in self.profiles:

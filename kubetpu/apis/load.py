@@ -62,6 +62,9 @@ def load_config(doc: Dict[str, Any]) -> KubeSchedulerConfiguration:
     cfg.batch_size = doc.get("batchSize", 256)  # TPU extension
     cfg.mode = doc.get("mode", "sequential")    # TPU extension
     cfg.kernel_backend = doc.get("kernelBackend", "lax")  # TPU extension
+    # TPU extension: depth-k pipelined executor (kubetpu/pipeline.py)
+    cfg.pipeline_cycles = bool(doc.get("pipelineCycles", False))
+    cfg.pipeline_depth = int(doc.get("pipelineDepth", 2))
     cfg.profiles = [_decode_profile(p) for p in doc.get("profiles", [])]
     apply_defaults(cfg)
     validate(cfg)
@@ -123,6 +126,8 @@ def validate(cfg: KubeSchedulerConfiguration,
         errs.append("mode must be 'sequential' or 'gang'")
     if cfg.kernel_backend not in ("lax", "pallas"):
         errs.append("kernelBackend must be 'lax' or 'pallas'")
+    if int(getattr(cfg, "pipeline_depth", 2) or 0) < 1:
+        errs.append("pipelineDepth must be >= 1")
     if cfg.pod_max_backoff_seconds < cfg.pod_initial_backoff_seconds:
         errs.append("podMaxBackoffSeconds must be >= podInitialBackoffSeconds")
     names = [p.scheduler_name for p in cfg.profiles]

@@ -30,6 +30,16 @@ from ..utils.metrics import SchedulerMetrics
 from . import hollow
 
 
+def host_share(device_wait_s: float, elapsed_s: float) -> float:
+    """ONE definition of the serial-exposure number every reporting
+    surface shares (bench.py's run_mode / pv_heavy cases and the perf
+    harness's SchedulerStats below — it used to be computed inline in
+    each): the fraction of wall time NOT spent blocked on the per-cycle
+    packed readback, i.e. the host-side share of the drain the depth-k
+    pipelined executor (kubetpu/pipeline.py) exists to hide."""
+    return round(1.0 - device_wait_s / max(elapsed_s, 1e-9), 3)
+
+
 @dataclass
 class Workload:
     """One benchmark case (reference: performance-config.yaml template +
@@ -314,8 +324,8 @@ def run_workload(w: Workload, verbose: bool = False) -> List[DataItem]:
             # host share of the measured phase
             DataItem(data={"Cycles": float(sched.cycle_count - cycles0),
                            "DeviceWaitS": round(device_wait, 3),
-                           "HostShare": round(
-                               1.0 - device_wait / max(elapsed, 1e-9), 3),
+                           "HostShare": host_share(device_wait,
+                                                   elapsed),
                            # incremental-tensorization health (state/delta)
                            # over the MEASURED phase only, like Cycles:
                            # rows the scatter path updated per delta cycle

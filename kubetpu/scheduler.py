@@ -94,6 +94,9 @@ class PreparedCycle:
     # per-pod host-filter rejection reasons (uid -> reason -> node count),
     # folded into the DecisionLog by the commit-path audit
     host_reject: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    # the cycle's host-plugin relevance map (_host_relevance) — kept so a
+    # scatter recovery's re-prepare never re-walks the plugin predicates
+    relevance: Optional[Dict[str, Tuple[bool, bool]]] = None
     # wall-clock of the device dispatch start — the deadline guard
     # measures dispatch-to-readback against it (0.0 = never dispatched)
     dispatch_t0: float = 0.0
@@ -106,10 +109,11 @@ class PreparedCycle:
     # window on OTHER work (the pipelined drain runs k-1's commit loop
     # there) — subtracted before the deadline comparison
     host_exempt_s: float = 0.0
-    # wall-clock when this cycle was parked in _inflight_cycle: caller
-    # think time between schedule_pending calls is host time too, and
-    # must not count against the dispatch deadline (a device hang still
-    # counts — it blocks the READBACK, which runs after pickup)
+    # wall-clock when this cycle was parked in the pipeline's in-flight
+    # ring: caller think time between schedule_pending calls is host
+    # time too, and must not count against the dispatch deadline (a
+    # device hang still counts — it blocks the READBACK, which runs
+    # after pickup)
     parked_t: float = 0.0
     # packed-readback completion time + the readback's device wait — the
     # SLO layer's commit-stage anchor and per-pod device share (stamped
@@ -267,8 +271,19 @@ class Scheduler:
         # the grace a recovery could trip the deadline it just served
         # and requeue forever (serving thread only)
         self._deadline_grace = 0
-        # pipelined drain: the dispatched-but-uncommitted cycle (prep, res)
-        self._inflight_cycle = None
+        # pipelined drain (kubetpu/pipeline.py): the depth-k executor
+        # owning the bounded ring of dispatched-but-uncommitted cycles.
+        # Depth 1 = synchronous, 2 = the historical double-buffered
+        # chain (the default), k parks up to k-1 cycles between calls.
+        # Env override so an operator can re-depth a live fleet.
+        from .pipeline import PipelinedExecutor, depth_from_env
+        self._pipeline = PipelinedExecutor(
+            self, depth_from_env(
+                getattr(self.config, "pipeline_depth", 2) or 2))
+        # last committed cycle's commit-failure flag (serving thread
+        # only): a failed commit invalidates the speculative chain and
+        # every in-flight cycle dispatched against it
+        self._last_commit_failed = False
         # (pod-axis bucket, compile-or-load seconds) per prewarmed program
         self.prewarm_report: List[Tuple[int, float]] = []
         self._bind_pool = ThreadPoolExecutor(max_workers=16,
@@ -431,190 +446,19 @@ class Scheduler:
         if (self.config.pipeline_cycles and not self.extenders
                 and self.config.mode == "gang" and self._mesh is None
                 and getattr(self.config, "chain_cycles", False)):
-            return self._schedule_pipelined(max_batch, timeout)
+            # the depth-k pipelined executor (kubetpu/pipeline.py):
+            # prepare(k+1) overlaps device(k) and commit/bind(k-1)
+            return self._pipeline.drain(max_batch, timeout)
         batch = self.queue.pop_batch(max_batch, timeout=timeout)
         if not batch:
             return []
         return self._schedule_batch(batch)
 
-    def _schedule_pipelined(self, max_batch: int,
-                            timeout: float) -> List[ScheduleOutcome]:
-        """Double-buffered drain: dispatch cycle k against the previous
-        cycle's SPECULATIVE chained cluster before committing cycle k-1, so
-        k's device execution overlaps both k-1's commit loop and k+1's
-        tensorize (the next call's prepare).  Outcomes lag one cycle; an
-        empty pop flushes the in-flight cycle.  If committing k-1 fails (or
-        invalidates the chain), the speculative dispatch of k is discarded
-        and k re-runs against a rebuilt snapshot — placements never diverge
-        from the non-pipelined path's guarantees."""
-        returned: List[ScheduleOutcome] = []
-        cycle_start = time.time()
-        while True:
-            # never block the pop while a finished cycle awaits its commit
-            # — flushing late delays binds and distorts drain timing
-            qpods = self.queue.pop_batch(
-                max_batch,
-                timeout=0.0 if self._inflight_cycle is not None else timeout)
-            prev = self._inflight_cycle
-            self._inflight_cycle = None
-
-            by_profile: Dict[str, List[QueuedPodInfo]] = {}
-            for qp in qpods:
-                if self._skip_pod_schedule(qp.pod):
-                    continue
-                by_profile.setdefault(qp.pod.spec.scheduler_name,
-                                      []).append(qp)
-            if len(by_profile) != 1:
-                # multi-profile batches (or nothing schedulable) fall back
-                # to the synchronous path; flush the in-flight cycle first
-                outcomes = self._finish_group(*prev) if prev else []
-                for name, group in by_profile.items():
-                    outcomes.extend(self._schedule_group(
-                        self.profiles[name], group))
-                outcomes = returned + outcomes
-                if self.metrics and outcomes:
-                    self.metrics.observe_cycle(len(outcomes),
-                                               time.time() - cycle_start)
-                return outcomes
-            (name, group), = by_profile.items()
-            fwk = self.profiles[name]
-            # ONE relevance walk for the whole cycle: the serialize
-            # decision below AND _prepare_group's host-mask gates share
-            # this map (the round-5 ADVICE double-walk finding)
-            relevance = self._host_relevance(fwk, group)
-            if prev is not None and any(
-                    rel for rel, _ in relevance.values()):
-                # host filter masks and the volume overlay are built from
-                # the CACHE, which excludes the uncommitted in-flight
-                # cycle's placements — preparing now could pass a node the
-                # in-flight cycle just filled (e.g. its last attachable
-                # volume), diverging from the synchronous drain.  Commit
-                # first; volume-less batches (the fast path) keep the
-                # overlap.
-                returned += self._finish_group(*prev)
-                prev = None
-            # prepare k: host tensorize work that overlaps cycle k-1's
-            # device execution (the real overlap — the tunnel serves
-            # transfers FIFO behind queued programs, so everything after
-            # the readback below is serialized with the device).
-            # uncommitted=prev: k-1's buffers must not be donated away
-            # before its commit-side device work runs
-            prep, early = self._prepare_group(
-                fwk, group, uncommitted=prev[0] if prev else None,
-                relevance=relevance)
-            if prep is None:
-                return (returned + early
-                        + (self._finish_group(*prev) if prev else []))
-            if prev is not None and not prep.used_chain:
-                # chain break (event landed / vocab overflow / bucket
-                # compaction): a fresh rebuild while k-1 is uncommitted
-                # would miss its placements and could oversubscribe nodes.
-                # Serialize: commit k-1 first, then re-tensorize with its
-                # placements in the cache.  Re-prepare only the pods that
-                # SURVIVED the first prepare — pods already failed there
-                # have final outcomes in `early`, and re-running _fail
-                # would duplicate events and preemption attempts.
-                returned += self._finish_group(*prev)
-                prev = None
-                stale = prep.trace
-                prep, early2 = self._prepare_group(fwk, prep.live,
-                                                   relevance=relevance)
-                stale.finish(discarded=True)
-                early += early2
-                if prep is None:
-                    return returned + early
-            # readback k-1 BEFORE dispatching k (FIFO tunnel), then
-            # dispatch k, then run k-1's commit loop while k executes
-            packed_prev = None
-            if prev is not None:
-                packed_prev, rec_prev = self._readback_guarded(*prev)
-                if rec_prev is not None:
-                    # k-1's dispatch errored or blew its deadline: it was
-                    # recovered (pods requeued, residents invalidated) —
-                    # and k, prepared against its chain/residents, must
-                    # be discarded and re-prepared from a fresh snapshot
-                    prev = None
-                    stale = prep.trace
-                    prep, early2 = self._prepare_group(
-                        fwk, prep.live, relevance=relevance)
-                    stale.finish(discarded=True)
-                    early += rec_prev + early2
-                    if prep is None:
-                        return returned + early
-            res = None
-            with prep.trace.stage("dispatch",
-                                  pipelined=prev is not None):
-                try:
-                    res = self._dispatch_group(
-                        prep,
-                        extra_uncommitted=(prev[0].batch.valid.shape[0]
-                                           if prev else 0))
-                except Exception as e:  # device fault at the dispatch
-                    # seam: recover k (requeue), still commit k-1 below
-                    early += self._recover_cycle(prep, repr(e),
-                                                 "dispatch-error")
-            if res is None:
-                prep.trace.finish(recovered="dispatch-error")
-                outcomes = []
-                if prev is not None:
-                    with prev[0].trace.stage("commit"):
-                        outcomes = self._commit_group(prev[0], packed_prev)
-                    prev[0].trace.finish()
-                self._sync_flight_dropped()
-                return returned + outcomes + early
-            self._last_commit_failed = False
-            if prev is not None:
-                # k-1's commit loop runs on the serving thread while k
-                # executes on device; its wall time (incl. sync-binding
-                # retry sleeps) lands between k's dispatch and readback,
-                # so it is EXEMPT from k's dispatch deadline — host-side
-                # commit cost must never demote a healthy device
-                t_commit = time.time()
-                with prev[0].trace.stage("commit"):
-                    outcomes = self._commit_group(prev[0], packed_prev)
-                prep.host_exempt_s += time.time() - t_commit
-                prev[0].trace.finish()
-                self._sync_flight_dropped()
-            else:
-                outcomes = []
-            if prep.used_chain and self._last_commit_failed:
-                # committing k-1 failed: this cycle was dispatched against
-                # a chain whose placements never materialized.  Discard
-                # and re-run synchronously over the surviving pods only
-                # (already-failed pods' outcomes in `early` are final)
-                stale = prep.trace
-                prep, early2 = self._prepare_group(fwk, prep.live,
-                                                   relevance=relevance)
-                stale.finish(discarded=True)
-                early += early2
-                if prep is None:
-                    return returned + outcomes + early
-                with prep.trace.stage("dispatch"):
-                    try:
-                        res = self._dispatch_group(prep)
-                    except Exception as e:
-                        early += self._recover_cycle(prep, repr(e),
-                                                     "dispatch-error")
-                        prep.trace.finish(recovered="dispatch-error")
-                        return returned + outcomes + early
-            prep.parked_t = time.time()
-            self._inflight_cycle = (prep, res)
-            returned += outcomes + early
-            if returned:
-                if self.metrics:
-                    self.metrics.observe_cycle(len(returned),
-                                               time.time() - cycle_start)
-                return returned
-            # pipe just primed (first cycle dispatched, nothing committed
-            # yet): loop to pop the next batch so this call still returns
-            # outcomes — "[] means no work" stays true for drain loops
-
     def flush_pipeline(self) -> List[ScheduleOutcome]:
-        """Commit any in-flight pipelined cycle (used at shutdown and by
-        callers that need every outcome materialized now)."""
-        prev = self._inflight_cycle
-        self._inflight_cycle = None
-        return self._finish_group(*prev) if prev else []
+        """Commit every in-flight pipelined cycle, oldest first (used at
+        shutdown and by callers that need every outcome materialized
+        now)."""
+        return self._pipeline.flush()
 
     def _schedule_batch(self, qpods: List[QueuedPodInfo]) -> List[ScheduleOutcome]:
         start = time.time()
@@ -689,14 +533,15 @@ class Scheduler:
         return out
 
     def _prepare_group(self, fwk: Framework, qpods: List[QueuedPodInfo],
-                       uncommitted: Optional[PreparedCycle] = None,
+                       uncommitted: Optional[List[PreparedCycle]] = None,
                        relevance: Optional[Dict[str, Tuple[bool, bool]]]
                        = None):
         """Host half of a cycle, up to (but excluding) the device dispatch:
         snapshot, PreFilter, tensorize-or-chain, host filter masks,
         nominated overlay.  Returns (PreparedCycle | None, early outcomes).
-        uncommitted: a dispatched-but-uncommitted pipelined cycle whose
-        device buffers must survive this prepare (gates delta donation)."""
+        uncommitted: EVERY dispatched-but-uncommitted pipelined cycle (the
+        depth-k executor's in-flight ring) whose device buffers must
+        survive this prepare (gates delta donation)."""
         # queue depths ride the cycle record; the read takes the queue's
         # condition lock, so it is GATED on the recorder being armed (the
         # disarmed hot path must take no new locks)
@@ -785,17 +630,19 @@ class Scheduler:
                     hard_pod_affinity_weight=fwk.hard_pod_affinity_weight,
                     mesh=self._mesh, profile=fwk.profile_name)
                 self._delta[fwk.profile_name] = delta
-            # in-place buffer donation is only safe when no
+            # in-place buffer donation is only safe when NO
             # dispatched-but-uncommitted pipelined cycle still reads the
             # resident buffers (its commit-side preemption wave and
-            # decision audit dispatch against prep.cluster).  The
-            # pipelined drain passes its in-flight cycle explicitly (it
-            # detaches self._inflight_cycle before preparing).
-            inflight = [uncommitted]
-            if self._inflight_cycle is not None:
-                inflight.append(self._inflight_cycle[0])
-            donate = not any(p is not None and p.cluster is delta.cluster
-                             for p in inflight)
+            # decision audit dispatch against prep.cluster).  ONE source
+            # of truth per call: the depth-k drain passes its in-flight
+            # ring explicitly; callers that don't (the synchronous path,
+            # scatter-recovery re-prepares) fall back to the executor's
+            # ring so a prepare racing parked cycles can never donate
+            # either.
+            inflight = (uncommitted if uncommitted is not None
+                        else self._pipeline.inflight_preps())
+            donate = delta.safe_to_donate(
+                [p.cluster for p in inflight if p is not None])
             # pending/nominated pods intern inside refresh (a compacting
             # resync re-interns them into its fresh table)
             cluster, dstats = delta.refresh(
@@ -999,7 +846,8 @@ class Scheduler:
             host_relevant=host_relevant, host_ok_dev=host_ok_dev, cfg=cfg,
             cycle_ctx=cycle_ctx, needs_topo=needs_topo,
             used_chain=use_chain, chain_pod_uids=chain_pod_uids,
-            score_bias=score_bias, host_reject=host_reject)
+            score_bias=score_bias, host_reject=host_reject,
+            relevance=relevance)
         return prep, outcomes
 
     def _dispatch_group(self, prep: PreparedCycle, extra_uncommitted: int = 0):
@@ -1207,8 +1055,8 @@ class Scheduler:
         time exceeded the configured deadline.  Either way the cycle is
         discarded pre-commit and recovered (_recover_cycle)."""
         if prep.parked_t:
-            # time parked in _inflight_cycle = caller think time between
-            # schedule_pending calls — exempt from the deadline
+            # time parked in the in-flight ring = caller think time
+            # between schedule_pending calls — exempt from the deadline
             prep.host_exempt_s += time.time() - prep.parked_t
             prep.parked_t = 0.0
         try:
@@ -1344,10 +1192,16 @@ class Scheduler:
         slo_trk = uslo.tracker()
         slo_host_dispatch = 0.0
         if slo_trk is not None and prep.dispatch_t0:
-            # host share of the dispatch->readback window (enqueue +
-            # overlapped host work); the device share is prep.device_wait
+            # host share of the dispatch->readback window (program
+            # enqueue); the device share is prep.device_wait.  The
+            # window's HOST-EXEMPT share — other ring slots' commit
+            # loops and readbacks, pipelined parking — is subtracted so
+            # depth-k overlap doesn't double-count the same wall-clock
+            # seconds into every in-flight cycle's pods (per-slot stage
+            # attribution, utils/slo.py)
             slo_host_dispatch = max(prep.readback_done_t - prep.dispatch_t0
-                                    - prep.device_wait, 0.0)
+                                    - prep.device_wait
+                                    - prep.host_exempt_s, 0.0)
         for i, qp in enumerate(live):
             state = states[qp.pod.uid]
             if chosen[i] < 0:

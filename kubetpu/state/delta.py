@@ -194,6 +194,16 @@ class DeltaTensorizer:
         the scheduler's chain guard — state/tensors.vocab_signature)."""
         return vocab_signature(self.builder.table)
 
+    def safe_to_donate(self, uncommitted_clusters) -> bool:
+        """Donation gate for the depth-k pipelined drain: the donated
+        scatter may only consume the resident buffers when NO
+        dispatched-but-uncommitted cycle's cluster IS the resident —
+        every in-flight ring slot's commit-side device work (preemption
+        wave, decision audit) still dispatches against its cluster, and
+        a donated buffer would be invalid by then.  Chained cycles hold
+        their own materialized clusters and never block donation."""
+        return not any(c is self.cluster for c in uncommitted_clusters)
+
     def pod_uid_list(self) -> List[Optional[str]]:
         """Row-ordered uid list sized to the pod-axis capacity (the
         scheduler's chain_pod_uids / CycleContext.pod_rows feed)."""

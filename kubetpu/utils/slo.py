@@ -16,7 +16,14 @@ unresolvable) pod lands here as a per-stage latency vector:
   cycle_wait   popped -> device dispatch (snapshot, PreFilter, tensorize,
                host masks; includes pipelined parking)
   dispatch     host share of the dispatch->readback window (program
-               enqueue + overlapped host work)
+               enqueue) MINUS the window's host-exempt share — other
+               in-flight ring slots' commit loops and readbacks plus
+               pipelined parking (``PreparedCycle.host_exempt_s``).
+               The subtraction is the depth-k PER-SLOT attribution: at
+               pipeline depth k the same wall-clock seconds sit inside
+               up to k overlapping dispatch->readback windows, and
+               without it every overlapped second would be counted once
+               per in-flight cycle, swamping ``stage_shares``
   device       the cycle's packed-readback block (``device_wait_s`` —
                the only point device completion is observable; every pod
                of a cycle shares the cycle's value)

@@ -21,6 +21,7 @@
 
 import copy
 import random
+import time
 
 import numpy as np
 import pytest
@@ -496,6 +497,228 @@ def test_pipelined_drain_survives_mid_drain_chain_break():
                                   for o in out if not o.node]
     assert len({o.pod.uid for o in out}) == 32, "a pod committed twice"
     sched.close()
+
+
+def test_depth4_chaos_dispatch_error_mid_drain():
+    """Chaos at depth (extends the mid-drain chain-break regression):
+    a seeded KUBETPU_CHAOS dispatch error fired mid-way through a
+    depth-4 pipelined drain — with multiple cycles dispatched but
+    uncommitted — must recover like the 2-deep chain did: every pod
+    still binds EXACTLY once, the backend demotes one rung
+    (pallas -> lax), and the recovery is auditable."""
+    from kubetpu.apis.config import (KubeSchedulerConfiguration,
+                                     KubeSchedulerProfile)
+    from kubetpu.client.store import ClusterStore
+    from kubetpu.scheduler import Scheduler
+    from kubetpu.utils import chaos
+    from kubetpu.utils import pallas_backend as PB
+
+    class CountingStore(ClusterStore):
+        def __init__(self):
+            super().__init__()
+            self.bind_calls = []
+
+        def bind(self, pod, node_name):
+            self.bind_calls.append(pod.metadata.name)
+            super().bind(pod, node_name)
+
+    chaos.disarm()
+    PB.reset_demotion()
+    store = CountingStore()
+    for n in hollow.make_nodes(8, zones=4):
+        store.add(n)
+    cfg = KubeSchedulerConfiguration(
+        profiles=[KubeSchedulerProfile()], batch_size=4, mode="gang",
+        chain_cycles=True, pipeline_cycles=True, pipeline_depth=4,
+        kernel_backend="pallas",
+        pod_initial_backoff_seconds=0.01, pod_max_backoff_seconds=0.05)
+    sched = Scheduler(store, config=cfg, async_binding=False)
+    try:
+        for p in hollow.make_pods(48, group_labels=4):
+            store.add(p)
+        out = []
+        # prime the ring: cycles dispatched-but-uncommitted, with a
+        # backlog still queued behind them
+        out.extend(sched.schedule_pending(timeout=0.0))
+        assert len(sched._pipeline.ring) >= 1
+        assert len(sched.queue) > 0
+        # ...then the device dies under cycle j's dispatch
+        chaos.arm(chaos.ChaosRegistry(seed=11).arm_point(
+            "dispatch", "error", n=1))
+        idle = 0
+        while idle < 6:
+            sched.queue.flush_backoff_completed()
+            got = sched.schedule_pending(timeout=0.0)
+            if got:
+                out.extend(got)
+                idle = 0
+            else:
+                got = sched.flush_pipeline()
+                if got:
+                    out.extend(got)
+                    idle = 0
+                else:
+                    idle += 1
+                    time.sleep(0.02)
+        placed = {o.pod.uid for o in out if o.node}
+        assert len(placed) == 48, f"{len(placed)} of 48 placed"
+        # exactly once: the bind oracle saw each pod one time
+        assert len(store.bind_calls) == len(set(store.bind_calls)) == 48
+        assert any(e["kind"] == "dispatch-error"
+                   for e in sched.recovery_log)
+        assert sched.recovery_log[0]["demoted"] == ["pallas->lax"]
+        assert PB.demotion() is not None
+    finally:
+        chaos.disarm()
+        PB.reset_demotion()
+        sched.close()
+
+
+def test_depth4_deadline_stall_reruns_younger_inflight_cycles(monkeypatch):
+    """Scatter recovery at depth: a seeded KUBETPU_CHAOS dispatch STALL
+    on cycle j of a depth-4 drain blows the dispatch deadline at j's
+    readback — j's pods requeue, and every YOUNGER in-flight cycle is
+    discarded and re-prepared against a fresh snapshot (the executor's
+    rerun counter proves it); every pod still binds exactly once.  The
+    compile-activity deadline exemption is pinned off (constant
+    snapshots) so the injected stall — not compile noise — trips the
+    deadline deterministically."""
+    from kubetpu.apis.config import (KubeSchedulerConfiguration,
+                                     KubeSchedulerProfile)
+    from kubetpu.client.store import ClusterStore
+    from kubetpu.scheduler import Scheduler
+    from kubetpu.utils import chaos
+    from kubetpu.utils import sanitize
+
+    class _FrozenTimer:
+        def snapshot(self):
+            return {}
+
+    monkeypatch.setattr(sanitize, "install_compile_timer",
+                        lambda: _FrozenTimer())
+    chaos.disarm()
+    store = ClusterStore()
+    for n in hollow.make_nodes(8, zones=4):
+        store.add(n)
+    cfg = KubeSchedulerConfiguration(
+        profiles=[KubeSchedulerProfile()], batch_size=4, mode="gang",
+        chain_cycles=True, pipeline_cycles=True, pipeline_depth=4,
+        dispatch_deadline_seconds=0.3,
+        pod_initial_backoff_seconds=0.01, pod_max_backoff_seconds=0.05)
+    sched = Scheduler(store, config=cfg, async_binding=False)
+    try:
+        for p in hollow.make_pods(48, group_labels=4):
+            store.add(p)
+        out = []
+        for _ in range(3):
+            out.extend(sched.schedule_pending(timeout=0.0))
+        # the stall: cycle j's dispatch hangs ~1 s — far past the 0.3 s
+        # deadline its own readback is measured against
+        chaos.arm(chaos.ChaosRegistry(seed=7).arm_point(
+            "dispatch", "stall", n=1, delay=1.0))
+        idle = 0
+        while idle < 6:
+            sched.queue.flush_backoff_completed()
+            got = sched.schedule_pending(timeout=0.0)
+            if got:
+                out.extend(got)
+                idle = 0
+            else:
+                got = sched.flush_pipeline()
+                if got:
+                    out.extend(got)
+                    idle = 0
+                else:
+                    idle += 1
+                    time.sleep(0.02)
+        placed = {o.pod.uid for o in out if o.node}
+        assert len(placed) == 48, f"{len(placed)} of 48 placed"
+        assert any(e["kind"] == "dispatch-deadline"
+                   for e in sched.recovery_log), sched.recovery_log
+        assert sched._pipeline.reruns >= 1, \
+            "no younger in-flight cycle was re-prepared by scatter"
+    finally:
+        chaos.disarm()
+        sched.close()
+
+
+def test_depth4_donation_withheld_while_ring_uncommitted():
+    """The generalized donation rule: with a depth-4 ring holding
+    multiple dispatched-but-uncommitted cycles, a chain break's delta
+    refresh must run donate=False whenever ANY in-flight cycle's cluster
+    IS the resident (its commit-side preemption wave / decision audit
+    still reads those buffers).  A foreign bound pod lands mid-drain to
+    force the delta path while the ring is populated."""
+    from kubetpu.apis.config import (KubeSchedulerConfiguration,
+                                     KubeSchedulerProfile)
+    from kubetpu.client.store import ClusterStore
+    from kubetpu.scheduler import Scheduler
+
+    store = ClusterStore()
+    for n in hollow.make_nodes(8, zones=4):
+        store.add(n)
+    cfg = KubeSchedulerConfiguration(
+        profiles=[KubeSchedulerProfile()], batch_size=4, mode="gang",
+        chain_cycles=True, pipeline_cycles=True, pipeline_depth=4)
+    sched = Scheduler(store, config=cfg, async_binding=False)
+    refreshes = []          # (donate, uncommitted-on-resident, ring len)
+    orig_refresh = DeltaTensorizer.refresh
+
+    def spy(self, node_infos, pending=(), donate=True):
+        on_resident = sum(
+            1 for p in sched._pipeline.ring.preps()
+            if p.cluster is self.cluster)
+        refreshes.append((donate, on_resident,
+                          len(sched._pipeline.ring)))
+        return orig_refresh(self, node_infos, pending=pending,
+                            donate=donate)
+
+    DeltaTensorizer.refresh = spy
+    try:
+        for p in hollow.make_pods(32, group_labels=4):
+            store.add(p)
+        out = []
+        foreigns = 0
+        for _ in range(30):
+            got = sched.schedule_pending(timeout=0.0)
+            out.extend(got)
+            if foreigns < 4 and len(sched._pipeline.ring) >= 1:
+                # a foreign writer binds a pod: chain dirty while
+                # cycles are in flight -> the next prepare takes the
+                # delta path against a populated ring.  Repeated so at
+                # least one break catches a DELTA-prepared cycle (whose
+                # cluster IS the resident) still uncommitted in the ring
+                foreign = hollow.make_pod(f"foreign-{foreigns}")
+                foreign.spec.node_name = hollow.make_nodes(8)[3].name
+                store.add(foreign)
+                foreigns += 1
+        out.extend(sched.flush_pipeline())
+        out.extend(_drain_sched(sched))
+        assert foreigns >= 2
+        assert len({o.pod.uid for o in out if o.node}) == 32
+        # every refresh that ran while an uncommitted cycle sat on the
+        # resident cluster withheld donation; refreshes with a clear
+        # ring (or chained in-flight cycles only) donated
+        assert refreshes, "no delta refresh ran"
+        withheld = [r for r in refreshes if r[1] > 0]
+        assert withheld, f"no refresh saw an uncommitted resident: " \
+                         f"{refreshes}"
+        assert all(r[0] is False for r in withheld), refreshes
+        assert all(r[0] is True for r in refreshes if r[1] == 0), refreshes
+    finally:
+        DeltaTensorizer.refresh = orig_refresh
+        sched.close()
+
+
+def _drain_sched(sched, max_cycles=30):
+    out = []
+    for _ in range(max_cycles):
+        got = sched.schedule_pending(timeout=0.0)
+        if not got:
+            break
+        out.extend(got)
+    out.extend(sched.flush_pipeline())
+    return out
 
 
 def test_flight_recorder_surfaces_delta_spans():

@@ -19,13 +19,19 @@ python -m tools.kubelint kubetpu/ --json
 # guarded-by annotated and its decide/act split must never sleep or
 # raise under the lock (blocking-under-lock).  The SLO tracker
 # (utils/slo.py) joins it: its sketch/exemplar state is guarded-by
-# annotated and observed from both the serving thread and binder pool
+# annotated and observed from both the serving thread and binder pool.
+# The depth-k pipelined executor (kubetpu/pipeline.py) joins it too: its
+# in-flight ring is guarded-by annotated, and no device dispatch,
+# readback or sleep may ever run under the ring lock
 python -m tools.kubelint kubetpu/utils/trace.py kubetpu/utils/decisions.py \
-	kubetpu/utils/chaos.py kubetpu/utils/slo.py --rules concurrency --json
+	kubetpu/utils/chaos.py kubetpu/utils/slo.py kubetpu/pipeline.py \
+	--rules concurrency --json
 # explicit delta-family pass over the serving loop: the cycle path must
 # stay scatter-only (full-retensorize-in-loop), independent of any
-# unrelated suppression elsewhere in the tree
-python -m tools.kubelint kubetpu/scheduler.py --rules delta --json
+# unrelated suppression elsewhere in the tree.  The pipelined executor
+# rides along — its drain is the cycle loop now
+python -m tools.kubelint kubetpu/scheduler.py kubetpu/pipeline.py \
+	--rules delta --json
 # compile-surface census (tools/kubecensus): jaxpr-level abstract
 # interpretation of every jit root.  Fails on (a) any unsuppressed
 # census finding — donation-unconsumed, f64-promotion, host-callback,
@@ -64,6 +70,12 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
 # armed-vs-disarmed placement-parity golden.
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
 	tests/test_slo.py -q -m 'not slow' -p no:cacheprovider
+# Depth-k pipelined executor (kubetpu/pipeline.py): depth-parity
+# placement goldens (depth 1 == 2 == 4 bit-identical), the
+# gather-window/free-slot gate, per-slot exemption accounting, ring-slot
+# flight tags, and the flush semantics.
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
+	tests/test_pipeline.py -q -m 'not slow' -p no:cacheprovider
 # Bench-trend CI check (tools/benchtrend.py, pure JSON, no jax): the
 # committed BENCH_r*/MULTICHIP_r* trajectory must stay schema-compatible
 # with the trend tooling, and the newest parseable round must not

@@ -175,6 +175,31 @@ def auction_summary(doc) -> str:
             + (f"; backend {b}" if b else "") + ")")
 
 
+def pipeline_summary(doc) -> str:
+    """One-line depth-k pipeline digest under the stage table: the
+    configured depth plus the ring-slot occupancy histogram (slot ->
+    cycles) read from cycle meta — slot 0 is a cycle dispatched straight
+    behind a commit, higher slots are cycles parked deeper in the
+    in-flight ring, so a spread across slots IS the overlap the depth-k
+    executor (kubetpu/pipeline.py) recovers."""
+    metas = []
+    if isinstance(doc.get("cycle_meta"), list):        # pipeline doc
+        metas = [c.get("meta", {}) for c in doc["cycle_meta"]]
+    elif isinstance(doc.get("cycles"), list):          # flightz dump
+        metas = [c.get("meta", {}) for c in doc["cycles"]]
+    slots = [m["ring_slot"] for m in metas
+             if isinstance(m.get("ring_slot"), int)]
+    if not slots:
+        return ""
+    depth = max((m.get("pipeline_depth") for m in metas
+                 if isinstance(m.get("pipeline_depth"), int)), default=0)
+    hist: Dict[int, int] = {}
+    for s in slots:
+        hist[s] = hist.get(s, 0) + 1
+    occ = " ".join(f"slot{k}:{n}" for k, n in sorted(hist.items()))
+    return f"pipeline: depth {depth}, ring occupancy {occ}"
+
+
 def cycle_tree(spans: List[dict], cycle: int,
                threshold_ms: float = 0.0) -> str:
     cs = [s for s in spans if s["cycle"] == cycle]
@@ -226,6 +251,9 @@ def main(argv=None) -> int:
     auction = auction_summary(doc)
     if auction:
         print(auction)
+    pipe = pipeline_summary(doc)
+    if pipe:
+        print(pipe)
     slo = slo_summary(doc)
     if slo:
         print(slo)
