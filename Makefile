@@ -4,7 +4,7 @@
 
 .PHONY: help lint lock-graph test sanitize-test race-test flight-test \
 	delta-test census census-test aot aot-test pallas-test chaos-test \
-	slo-test pipeline-test trend trace bench
+	slo-test pipeline-test journal-test replay-test trend trace bench
 
 help:
 	@echo "kubetpu targets:"
@@ -59,6 +59,17 @@ help:
 	@echo "                      placement goldens, gather-window gating on"
 	@echo "                      free ring slots, per-slot exemption"
 	@echo "                      accounting, chaos-at-depth scatter recovery"
+	@echo "  make journal-test   durable cycle journal suite"
+	@echo "                      (kubetpu/utils/journal.py): record schema,"
+	@echo "                      size-cap eviction counting, chaos write"
+	@echo "                      degradation, disarmed zero-lock poison,"
+	@echo "                      armed-vs-disarmed placement parity,"
+	@echo "                      /debug/journal round trip"
+	@echo "  make replay-test    bit-exact replay rig suite (tools/"
+	@echo "                      kubereplay): 50+-cycle depth-4 journaled"
+	@echo "                      drain replays byte-identical, corrupt-"
+	@echo "                      record skip with reason, counterfactual"
+	@echo "                      score-weight/pipelineDepth divergence"
 	@echo "  make trend          per-case bench trend table over the committed"
 	@echo "                      BENCH_r*.json trajectory with per-stage"
 	@echo "                      regression attribution (tools/benchtrend.py)"
@@ -159,6 +170,23 @@ pipeline-test:
 		tests/test_pipeline.py tests/test_chain.py -q -p no:cacheprovider
 	JAX_PLATFORMS=cpu python -m pytest \
 		tests/test_delta.py -q -k 'depth4 or pipelined' -p no:cacheprovider
+
+# durable cycle journal (kubetpu/utils/journal.py): on-disk record
+# store bounds + eviction counting, the chaos journal point's
+# degrade-to-drop contract, the disarmed-hot-path poison test, and the
+# armed-vs-disarmed placement-parity golden
+journal-test:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_journal.py -q -p no:cacheprovider
+
+# bit-exact replay rig (tools/kubereplay): the journaled-drain replay
+# oracle (byte-identical packed placements incl. delta cycles, resyncs
+# and a depth-4 pipelined segment), per-record corrupt-skip reasons, and
+# the counterfactual divergence contracts (score weight nonzero,
+# pipelineDepth zero)
+replay-test:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_replay.py -q -m 'not slow' -p no:cacheprovider
 
 # bench trend table + regression attribution over the committed rounds
 trend:

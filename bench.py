@@ -349,12 +349,22 @@ def compile_estimate(first, best):
     return round(max(first - best, 0.0), 1)
 
 
+def _journal_armed() -> bool:
+    """Whether the durable cycle journal rode this case's cycles —
+    recorded in every case's JSON so a committed bench round states
+    whether its numbers include journal-write overhead (normally False;
+    replay_fidelity arms a private journal for its own drain)."""
+    from kubetpu.utils import journal as ujournal
+    return ujournal.journal() is not None
+
+
 def mode_summary(mode, best, first, outcomes, sched, stats):
     scheduled = sum(1 for o in outcomes if o.node)
     d = {"e2e_best_s": round(best, 3),
          "first_run_s": round(first, 3),
          "compile_s": compile_estimate(first, best),
          "scheduled": scheduled,
+         "journal_armed": _journal_armed(),
          "pods_per_sec": round(len(outcomes) / best, 1)}
     d.update(stats or {})
     if scheduled < len(outcomes):
@@ -467,6 +477,21 @@ def northstar_gate(detail, path="NORTHSTAR.json"):
             "pipeline_depth: depth-k placements diverged from the "
             "depth-1 synchronous drain (bit-identity contract, "
             "kubetpu/pipeline.py)")
+    # ...and for the journal replay rig: a journaled drain must replay
+    # to byte-identical placements (utils/journal.py + tools/kubereplay
+    # — the same oracle discipline), and a pipelineDepth counterfactual
+    # must be inert (depth never reaches a device program)
+    rf = detail.get("replay_fidelity", {})
+    if rf.get("bit_match") is False:
+        failures.append(
+            "replay_fidelity: journaled cycles did not replay to "
+            "bit-identical placements (kubetpu/utils/journal.py + "
+            "tools/kubereplay oracle)")
+    if rf.get("counterfactual", {}).get(
+            "pipeline_depth_divergent_cycles", 0):
+        failures.append(
+            "replay_fidelity: a pipelineDepth counterfactual changed "
+            "placements — executor depth leaked into a device program")
     try:
         with open(path) as f:
             doc = json.load(f)
@@ -656,6 +681,7 @@ def pv_heavy_case(n_nodes=1000, n_pods=2048):
             }
     stats["repeat_raw_s"] = raw_s
     stats["spread"] = _spread(raw_s[1:])
+    stats["journal_armed"] = _journal_armed()
     sched.close()
     return stats
 
@@ -731,6 +757,7 @@ def node_flap_case(n_nodes=256, n_pods=1024, waves=4, flap=24):
         "resync_count": sched.resync_count,
         "delta_rows_p50": _median(list(sched.delta_rows)),
         "recoveries": len(sched.recovery_log),
+        "journal_armed": _journal_armed(),
     }
     latency = _latency_block(slo_trk)
     if latency is not None:
@@ -777,7 +804,120 @@ def preemption_case(n_nodes=500, fillers=2000, high_prio=256):
         warm = raw[1:] or raw
         best["spread"] = {"min": min(warm), "median": _median(warm),
                           "max": max(warm)}
+        best["journal_armed"] = _journal_armed()
     return best
+
+
+def replay_fidelity_case(n_nodes=12, n_pods=240, batch=8, depth=4):
+    """Durable-journal replay oracle (kubetpu/utils/journal.py +
+    tools/kubereplay): a deterministic heterogeneous world — mixed node
+    capacities and zones, 1/3 of pods carrying soft zone spread so the
+    score plugins genuinely disagree — is drained at pipeline depth 4
+    with mid-drain node churn (chain breaks -> delta cycles + resyncs),
+    journaled to a private directory, and replayed IN-PROCESS:
+
+      * bit_match: every journaled cycle must replay to a byte-identical
+        packed placement vector.  Under BENCH_GATE=1 a mismatch fails
+        the run like warm_restart's placements_match — bit-identity is
+        correctness, no recorded floor needed.
+      * counterfactual: the SAME window re-run with PodTopologySpread's
+        score weight zeroed must report NONZERO placement divergence
+        (the eval-set axis works), while a pipelineDepth change must
+        report ZERO (executor depth never reaches a device program) —
+        both recorded, the depth check gated."""
+    import copy
+    import shutil
+    import tempfile
+
+    from kubetpu.api import types as api
+    from kubetpu.apis.config import (KubeSchedulerConfiguration,
+                                     KubeSchedulerProfile)
+    from kubetpu.client.store import ClusterStore
+    from kubetpu.harness import hollow
+    from kubetpu.scheduler import Scheduler
+    from kubetpu.utils import journal as ujournal
+    from tools.kubereplay import replay_journal
+
+    work = tempfile.mkdtemp(prefix="kubetpu-journal-")
+    ujournal.disarm_journal()
+    jr = ujournal.arm_journal(work)
+    sched = None
+    try:
+        store = ClusterStore()
+        nodes = []
+        for i in range(n_nodes):
+            n = hollow.make_node(f"jr-node-{i}", zone=f"zone-{i % 3}",
+                                 region="region-0",
+                                 cpu_milli=8000 if i % 2 else 3000)
+            nodes.append(n)
+            store.add(n)
+        cfg = KubeSchedulerConfiguration(
+            profiles=[KubeSchedulerProfile()], batch_size=batch,
+            mode="gang", chain_cycles=True, pipeline_cycles=True,
+            pipeline_depth=depth)
+        sched = Scheduler(store, config=cfg, async_binding=False)
+        for i, p in enumerate(hollow.make_pods(n_pods, prefix="jr-",
+                                               group_labels=4,
+                                               cpu_milli=150)):
+            if i % 3 == 0:
+                hollow.with_spread(p, api.LABEL_ZONE,
+                                   when="ScheduleAnyway")
+            store.add(p)
+        outcomes = []
+        i = 0
+        t0 = time.time()
+        while True:
+            got = sched.schedule_pending(timeout=0.0)
+            if not got:
+                break
+            outcomes.extend(got)
+            i += 1
+            if i % 7 == 0:
+                # external node churn: chain break -> delta/resync path
+                n = copy.deepcopy(nodes[i % len(nodes)])
+                n.metadata.labels["flap"] = f"v{i}"
+                store.update(n)
+        outcomes.extend(sched.flush_pipeline())
+        drain_s = time.time() - t0
+        t1 = time.time()
+        rep = replay_journal(work)
+        replay_s = time.time() - t1
+        cf_w = replay_journal(work, counterfactual={
+            "score_weights": {"PodTopologySpread": 0}})["counterfactual"]
+        cf_d = replay_journal(work, counterfactual={
+            "pipeline_depth": depth * 2})["counterfactual"]
+        out = {
+            "nodes": n_nodes, "pods": len(outcomes),
+            "scheduled": sum(1 for o in outcomes if o.node),
+            "cycles": sched.cycle_count,
+            "pipeline_depth": depth,
+            "drain_s": round(drain_s, 3),
+            "replay_s": round(replay_s, 3),
+            "records": rep["records"],
+            "replayed": rep["replayed"],
+            "skipped": len(rep["skipped"]),
+            "journal_bytes": jr.disk_bytes(),
+            "journal_armed": True,
+            # the gated oracle (northstar_gate, like placements_match)
+            "bit_match": rep["bit_match"] is True,
+            "counterfactual": {
+                "score_weight_divergent_cycles":
+                    cf_w["divergent_cycles"],
+                "score_weight_pods_moved": cf_w["diverged_pods"],
+                "utilization_delta": cf_w["utilization"]["delta"],
+                # must be 0 — depth never reaches a device program
+                "pipeline_depth_divergent_cycles":
+                    cf_d["divergent_cycles"],
+            },
+        }
+        if rep["first_divergence"] is not None:
+            out["first_divergence"] = rep["first_divergence"]["seq"]
+        return out
+    finally:
+        if sched is not None:
+            sched.close()
+        ujournal.disarm_journal()
+        shutil.rmtree(work, ignore_errors=True)
 
 
 def _restart_once(n_nodes, existing_per_node, wave, ladder, timer):
@@ -907,6 +1047,7 @@ def warm_restart_case(n_nodes=1000, existing_per_node=2, wave=1024,
         modes["aot_artifact"]["artifact_rows"] = build.get("rows")
         out["modes"] = modes
         out["placements_match"] = (p_cold == p_warm == p_aot)
+        out["journal_armed"] = _journal_armed()
         # the gated number: restart-to-first-placement with artifacts
         # shipped — what a rolling fleet restart actually costs
         out["cold_restart_s"] = modes["aot_artifact"]["restart_s"]
@@ -999,6 +1140,7 @@ def rescore_case(n_pods=51200, n_nodes=10240, chunk=4096):
             "pods_per_sec": round(len(outcomes) / dt, 1),
             "scheduled": scheduled,
             "hbm_peak_bytes": int(mem.get("peak_bytes_in_use", 0)),
+            "journal_armed": _journal_armed(),
         }
         latency = _latency_block(slo_trk)
         if latency is not None:
@@ -1076,6 +1218,7 @@ def backend_compare_case(n_nodes=512, n_pods=2048, existing_per_node=2,
     return {"nodes": n_nodes, "pods": n_pods,
             "interpret_mode": jax.default_backend() != "tpu",
             "lax": s_lax, "pallas": s_pal,
+            "journal_armed": _journal_armed(),
             "placements_match": bool(p_lax) and p_lax == p_pal}
 
 
@@ -1222,6 +1365,12 @@ def main() -> None:
                 n_nodes=min(n_nodes, 512), n_pods=min(n_pods, 2048))
         except Exception as e:  # pragma: no cover - depends on device state
             detail["backend_compare"] = {"error": repr(e)}
+
+    if os.environ.get("BENCH_REPLAY", "1") == "1" and mesh_shape is None:
+        try:
+            detail["replay_fidelity"] = replay_fidelity_case()
+        except Exception as e:  # pragma: no cover - depends on device state
+            detail["replay_fidelity"] = {"error": repr(e)}
 
     if full:
         northstar = {}

@@ -359,12 +359,14 @@ class PipelinedExecutor:
                                     time.time() - cycle_start)
                             ring.park(time.time())
                             return returned
+            # ring-slot tag: which pipeline slot this cycle parked in
+            # (0 = dispatched straight behind the commit) — traceview
+            # renders the slot occupancy so the overlap is visible, and
+            # the cycle journal records it on the committed record
+            prep.ring_slot = len(ring)
             rec = prep.trace.rec
             if rec is not None:
-                # ring-slot tag: which pipeline slot this cycle parked in
-                # (0 = dispatched straight behind the commit) — traceview
-                # renders the slot occupancy so the overlap is visible
-                rec.meta["ring_slot"] = len(ring)
+                rec.meta["ring_slot"] = prep.ring_slot
                 rec.meta["pipeline_depth"] = self.depth
             if ring.capacity == 0:
                 # depth 1: fully synchronous — the cycle commits before
@@ -462,6 +464,10 @@ class PipelinedExecutor:
         recorded relevance map, so the host-plugin walk never re-runs."""
         s = self.sched
         stale = prep.trace
+        # the discarded cycle may have consumed a journal capture that
+        # will now never be journaled — the next journaled cycle must
+        # re-anchor (scheduler._journal_note_discard; no-op disarmed)
+        s._journal_note_discard(prep)
         new_prep, early = s._prepare_group(prep.fwk, prep.live,
                                            relevance=prep.relevance)
         stale.finish(discarded=True)

@@ -46,6 +46,7 @@ from .state.cache import SchedulerCache, Snapshot
 from .state.delta import DeltaTensorizer
 from .state.tensors import SnapshotBuilder
 from .utils import chaos as uchaos
+from .utils import journal as ujournal
 from .utils import slo as uslo
 from .utils import trace as utrace
 from .utils.decisions import DecisionLog, PodDecision
@@ -121,6 +122,15 @@ class PreparedCycle:
     # beyond the one the wait measurement already makes)
     readback_done_t: float = 0.0
     device_wait: float = 0.0
+    # cycle-journal capture (utils/journal.py, armed only): the cycle's
+    # cluster-input provenance — ("resync"|"delta"|"noop", payload) from
+    # the DeltaTensorizer seam or ("chain", pads) for chained cycles —
+    # plus the RNG fold counter and sequential start index the dispatch
+    # consumed, and the pipeline ring slot the cycle parked in
+    journal_input: Optional[tuple] = None
+    journal_rng: int = 0
+    journal_start: int = 0
+    ring_slot: int = 0
 
 
 class Scheduler:
@@ -146,6 +156,11 @@ class Scheduler:
         # disarmed (the default) every seam is one attribute read and the
         # hot path takes zero new locks (tests/test_slo.py poison test)
         uslo.maybe_arm_from_env()
+        # KUBETPU_JOURNAL=<dir>: arm the durable cycle journal
+        # (utils/journal.py) — every committed cycle appends one
+        # self-contained replayable record; disarmed, every seam is one
+        # attribute read (tests/test_journal.py poison test)
+        ujournal.maybe_arm_from_env()
         import jax
         self.store = store
         self.config = config or KubeSchedulerConfiguration(
@@ -264,6 +279,18 @@ class Scheduler:
         # serving daemon's memory one incident dict per cycle forever
         self.recovery_log: deque = deque(maxlen=256)
         self._chaos_seen: Dict[str, int] = {}
+        # journal counters already folded into the scheduler_journal_*
+        # metrics (serving thread only, like _chaos_seen)
+        self._journal_seen = (0, 0)   # (records_total, dropped_total)
+        # PROFILES whose discarded pipelined cycle consumed a
+        # delta/resync journal capture that will never be journaled
+        # (chain-break re-prepare, scatter recovery): that profile's
+        # resident has advanced past what the journal stream describes,
+        # so its next journaled cycle must re-anchor from the mirror or
+        # replay silently diverges.  Per-profile (each profile owns its
+        # own DeltaTensorizer lineage — another profile's cycle must not
+        # consume the flag); serving thread only
+        self._journal_force_anchor: set = set()
         # deadline grace: cycles exempt from the deadline right after a
         # recovery — the recovery itself invalidates residents and can
         # change the traced program (demotion, new pod bucket), so the
@@ -604,6 +631,7 @@ class Scheduler:
         # addNominatedPods topology overlay) — their vocab must be interned
         # before snapshot arrays are sized
         nom_pinfos = [PodInfo(pod) for pod, _ in self.queue.all_nominated()]
+        journal_input = None
         with self._chain_lock:
             chain = self._chain
         use_chain = (chain is not None and chain["seq"] == chain_seq0
@@ -618,6 +646,11 @@ class Scheduler:
         if use_chain:
             cluster = chain["cluster"]
             chain_pod_uids = chain["pod_uids"]
+            if ujournal.journal() is not None:
+                # journal provenance: this cycle's cluster is the
+                # previous committed cycle's auction, materialized at
+                # the pad buckets the chain recorded
+                journal_input = ("chain", chain.get("pads"))
         else:
             # incremental tensorization (state/delta.py): the resident
             # device cluster is brought up to date by a bounded scatter
@@ -679,6 +712,24 @@ class Scheduler:
                 self.delta_rows.append(dstats.delta_rows)
                 self.delta_cycle_count += 1
             chain_pod_uids = delta.pod_uid_list()
+            # journal capture seam (state/delta.py): the exact resync
+            # snapshot / delta tables / zero-dirty marker this refresh
+            # applied — None when the journal is disarmed
+            journal_input = delta.take_capture()
+            if journal_input is not None:
+                if (fwk.profile_name in self._journal_force_anchor
+                        and journal_input[0] != "resync"):
+                    # THIS profile's discarded cycle applied a
+                    # delta/resync capture that never journaled, so its
+                    # resident is ahead of the journal stream —
+                    # re-anchor from the mirror (bit-equal to the
+                    # resident after any successful refresh, the
+                    # anti-entropy verifier's invariant).  The capture
+                    # format is owned by ONE site: the tensorizer's own
+                    # resync seam
+                    delta._capture_resync()
+                    journal_input = delta.take_capture()
+                self._journal_force_anchor.discard(fwk.profile_name)
             with self._chain_lock:
                 self._chain = None
         spread_sels = [self.store.default_spread_selector(pi.pod)
@@ -847,7 +898,7 @@ class Scheduler:
             cycle_ctx=cycle_ctx, needs_topo=needs_topo,
             used_chain=use_chain, chain_pod_uids=chain_pod_uids,
             score_bias=score_bias, host_reject=host_reject,
-            relevance=relevance)
+            relevance=relevance, journal_input=journal_input)
         return prep, outcomes
 
     def _dispatch_group(self, prep: PreparedCycle, extra_uncommitted: int = 0):
@@ -874,6 +925,7 @@ class Scheduler:
             from .utils.sanitize import install_compile_timer
             prep.compile_snap = install_compile_timer().snapshot()
         uchaos.raise_or_stall("dispatch")
+        seq_start = 0
         # ---- device: one program for the whole group (scan or auction)
         if self.config.mode == "gang":
             needs_topo = prep.needs_topo
@@ -897,7 +949,7 @@ class Scheduler:
             # scheduler paying a multi-MB transfer it may never need
             cycle_ctx.set_lazy_verdicts(res.feasible0, res.unresolvable)
         else:
-            start = self._next_start_node_index % max(n_nodes, 1)
+            start = seq_start = self._next_start_node_index % max(n_nodes, 1)
             if self._mesh is not None:
                 from .parallel import mesh as pmesh
                 res = pmesh.sharded_schedule_sequential(
@@ -915,6 +967,13 @@ class Scheduler:
                     host_ok=host_ok_dev,
                     start_index=start,
                     score_bias=prep.score_bias)
+        if ujournal.journal() is not None:
+            # journal provenance: the RNG fold counter this dispatch
+            # consumed (_next_rng bumped it inside the call above) and
+            # the sequential rotating start — exactly what kubereplay
+            # feeds back into the same program
+            prep.journal_rng = self._rng_counter
+            prep.journal_start = seq_start
         # request the packed readback transfer BEFORE enqueueing the chain
         # materialize: the tunnel serves FIFO, so a transfer requested
         # after materialize would wait for it — this way the readback
@@ -966,7 +1025,13 @@ class Scheduler:
                                    pod_uids=uids, seq=prep.chain_seq0,
                                    caps=_vocab_caps(prep.builder.table),
                                    profile=fwk.profile_name,
-                                   n_nodes=n_nodes)
+                                   n_nodes=n_nodes,
+                                   # journal provenance: the pad buckets
+                                   # a chained successor must feed back
+                                   # into materialize_assigned to rebuild
+                                   # this cluster bit-exactly
+                                   pads=(pow2_bucket(p_next),
+                                         pow2_bucket(e_next)))
         elif self.config.mode == "gang":
             with self._chain_lock:
                 self._chain = None
@@ -1190,6 +1255,12 @@ class Scheduler:
         # disarmed, no stage vectors are built and no clock is read — the
         # zero-new-locks hot-path contract (tests/test_slo.py)
         slo_trk = uslo.tracker()
+        # durable cycle journal (utils/journal.py): reserve this cycle's
+        # record id UP FRONT so the SLO exemplars of its pods can carry
+        # it (the record itself appends after the commit loop, once the
+        # outputs and audit summary exist).  Disarmed: one attribute read
+        jr = ujournal.journal()
+        jr_seq = jr.next_seq() if jr is not None else 0
         slo_host_dispatch = 0.0
         if slo_trk is not None and prep.dispatch_t0:
             # host share of the dispatch->readback window (program
@@ -1211,7 +1282,8 @@ class Scheduler:
                                  not unres[i]))
                 continue
             node_name = node_infos[chosen[i]].node_name
-            slo = (self._slo_prefix(qp, prep, slo_host_dispatch, flight)
+            slo = (self._slo_prefix(qp, prep, slo_host_dispatch, flight,
+                                    jr_seq)
                    if slo_trk is not None and qp.pop_timestamp else None)
             outcome = self._commit(fwk, qp, state, node_name,
                                    n_feas[i], pinfo=pinfos[i],
@@ -1304,7 +1376,8 @@ class Scheduler:
                 qp.slo_unres_observed = True
                 self._slo_observe_terminal(
                     slo_trk,
-                    self._slo_prefix(qp, prep, slo_host_dispatch, flight),
+                    self._slo_prefix(qp, prep, slo_host_dispatch, flight,
+                                     jr_seq),
                     qp, "unresolvable")
         # a commit-path failure invalidates the speculative chain (and any
         # later cycle already dispatched against it — the pipelined drain
@@ -1313,19 +1386,34 @@ class Scheduler:
         if commit_failed and self.config.mode == "gang":
             with self._chain_lock:
                 self._chain = None
+        if jr is not None:
+            # one self-contained replayable record per committed cycle;
+            # ANY failure (unpicklable capture, disk, injected chaos)
+            # degrades to a counted drop — recording never fails a cycle
+            try:
+                self._journal_append(jr, jr_seq, prep, packed, outcomes,
+                                     audit_rows)
+            except Exception:
+                jr.note_drop()
+                import logging
+                logging.getLogger("kubetpu").warning(
+                    "cycle journal record %d dropped", jr_seq,
+                    exc_info=True)
         trace.step("Committing placements done")
         trace.log_if_long()
         return outcomes
 
     @staticmethod
     def _slo_prefix(qp: QueuedPodInfo, prep: PreparedCycle,
-                    host_dispatch: float, flight) -> Dict[str, float]:
+                    host_dispatch: float, flight,
+                    journal_seq: int = 0) -> Dict[str, float]:
         """The cycle-side half of a pod's per-stage latency vector
         (utils/slo.py): queue_wait/backoff/cycle_wait/dispatch/device,
-        plus two underscore-prefixed meta keys the terminal observer
-        pops before recording (the readback anchor for the commit stage
-        and the flight-recorder cycle seq the exemplar links to).
-        Called only with the tracker armed and a stamped pop time."""
+        plus underscore-prefixed meta keys the terminal observer pops
+        before recording (the readback anchor for the commit stage, the
+        flight-recorder cycle seq the exemplar links to, and the cycle's
+        journal record id when KUBETPU_JOURNAL is armed).  Called only
+        with the tracker armed and a stamped pop time."""
         return {
             "queue_wait": max(qp.pop_timestamp - qp.timestamp, 0.0),
             "backoff": max(qp.timestamp - qp.initial_attempt_timestamp,
@@ -1336,6 +1424,7 @@ class Scheduler:
             "device": prep.device_wait,
             "_readback_done_t": prep.readback_done_t,
             "_flight_seq": float(flight.seq) if flight is not None else 0.0,
+            "_journal_seq": float(journal_seq),
         }
 
     def _slo_observe_terminal(self, trk, prefix: Dict[str, float],
@@ -1348,6 +1437,7 @@ class Scheduler:
         now = time.time()
         stages = dict(prefix)
         seq = stages.pop("_flight_seq", 0)
+        jseq = stages.pop("_journal_seq", 0)
         rb = stages.pop("_readback_done_t", 0.0)
         end = bind_start if bind_start is not None else now
         stages["commit"] = max(end - rb, 0.0)
@@ -1358,7 +1448,124 @@ class Scheduler:
         trk.observe_pod(stages, pod=pod.metadata.name,
                         namespace=pod.namespace, uid=pod.uid,
                         outcome=outcome, attempts=qp.attempts,
-                        cycle=self.cycle_count, flight_seq=int(seq))
+                        cycle=self.cycle_count, flight_seq=int(seq),
+                        journal_seq=int(jseq))
+
+    def _journal_note_discard(self, prep: PreparedCycle) -> None:
+        """A prepared cycle is being discarded without committing (the
+        pipelined executor's chain-break/scatter re-prepare).  If its
+        journal capture carried resident state (delta scatter or resync),
+        that state is now applied on device but will never be journaled
+        — flag the PROFILE's next journaled cycle to re-anchor.
+        Chain/noop captures carry no resident state and need nothing."""
+        if prep.journal_input is not None \
+                and prep.journal_input[0] in ("delta", "resync"):
+            self._journal_force_anchor.add(prep.fwk.profile_name)
+
+    def _journal_append(self, jr, jr_seq: int, prep: PreparedCycle,
+                        packed: np.ndarray, outcomes, audit_rows) -> None:
+        """Assemble + append one cycle-journal record (armed only; the
+        caller degrades any failure to a counted drop).  The record is
+        SELF-CONTAINED: everything tools/kubereplay needs to re-execute
+        this cycle's device program and bit-match its packed output —
+        inputs (cluster provenance, pod batch, cfg, masks, RNG fold),
+        outputs (packed vector, placements, verdict summary) and the
+        linkage ids into the flight-recorder seq and decision-audit
+        cycle.  ``host_ok``/``score_bias`` are read back from device
+        here — an armed journal pays that transfer on the commit side;
+        the disarmed path never reaches this method."""
+        mode = self.config.mode
+        fwk, live = prep.fwk, prep.live
+        kind, payload = prep.journal_input or ("unknown", None)
+        kernel_backend = (self._gang_backend(prep) if mode == "gang"
+                          else "lax")
+        hard_w = float(fwk.hard_pod_affinity_weight)
+        placements: Dict[str, str] = {}
+        blocking: Dict[str, int] = {}
+        scheduled = failed = 0
+        for i, qp in enumerate(live):
+            o = outcomes[i] if i < len(outcomes) else None
+            node = o.node if o is not None else ""
+            placements[qp.pod.metadata.name] = node
+            if node:
+                scheduled += 1
+            else:
+                failed += 1
+                info = (audit_rows or {}).get(qp.pod.uid, {})
+                for plugin in info.get("blocking", []):
+                    blocking[plugin] = blocking.get(plugin, 0) + 1
+        host_reasons: Dict[str, int] = {}
+        for counts in prep.host_reject.values():
+            for reason, n in counts.items():
+                host_reasons[reason] = host_reasons.get(reason, 0) + n
+        flight = prep.trace.rec
+        record = {
+            "v": ujournal.RECORD_VERSION,
+            "seq": jr_seq,
+            "cycle": self.cycle_count,
+            "ts": time.time(),
+            "mode": mode,
+            "profile": fwk.profile_name,
+            # ---- inputs ----
+            "input": kind,
+            "input_payload": payload,
+            "batch": prep.batch,
+            "cfg": prep.cfg,
+            "host_ok": (np.asarray(prep.host_ok_dev)
+                        if prep.host_ok_dev is not None else None),
+            "score_bias": (np.asarray(prep.score_bias)
+                           if prep.score_bias is not None else None),
+            "needs_topo": bool(prep.needs_topo),
+            "rng_counter": int(prep.journal_rng),
+            "start_index": int(prep.journal_start),
+            "kernel_backend": kernel_backend,
+            "hard_pod_affinity_weight": hard_w,
+            "mesh": self._mesh is not None,
+            "vocab_sig": _vocab_caps(prep.builder.table),
+            "n_nodes": len(prep.node_infos),
+            # node row order only on anchor records — delta/chain records
+            # provably keep it (a node-set change forces a resync)
+            "node_names": ([ni.node_name for ni in prep.node_infos]
+                           if kind == "resync" else None),
+            "config_digest": ujournal.config_digest(
+                mode, fwk.profile_name, prep.cfg, hard_w,
+                self.config.kernel_backend),
+            # ---- outputs ----
+            "packed": np.asarray(packed),
+            "rounds": (self.last_gang_rounds if mode == "gang" else 0),
+            "pods": [(qp.pod.metadata.name, qp.pod.namespace, qp.pod.uid)
+                     for qp in live],
+            "placements": placements,
+            "verdicts": {"scheduled": scheduled, "failed": failed,
+                         "blocking": blocking,
+                         "host_reasons": host_reasons},
+            # ---- linkage ----
+            "links": {
+                "flight_seq": int(flight.seq) if flight is not None else 0,
+                "decision_cycle": self.cycle_count,
+                "ring_slot": int(prep.ring_slot),
+                "pipeline_depth": int(self._pipeline.depth
+                                      if self.config.pipeline_cycles
+                                      else 1),
+            },
+        }
+        jr.append(record)
+
+    def _sync_journal_metrics(self) -> None:
+        """Fold the armed journal's counters into scheduler_journal_*
+        (serving thread only, like _sync_chaos_metrics); disarmed this
+        is one attribute read."""
+        jr = ujournal.journal()
+        if jr is None or self.metrics is None:
+            return
+        records, dropped = jr.counters()
+        seen_r, seen_d = self._journal_seen
+        if records > seen_r:
+            self.metrics.journal_records.inc(amount=records - seen_r)
+        if dropped > seen_d:
+            self.metrics.journal_dropped.inc(amount=dropped - seen_d)
+        self._journal_seen = (max(records, seen_r), max(dropped, seen_d))
+        self.metrics.journal_bytes.set(jr.disk_bytes())
 
     def _sync_chaos_metrics(self) -> None:
         """Fold the armed chaos registry's fire counts into
@@ -1378,6 +1585,7 @@ class Scheduler:
         counter — called right after each cycle record commits (serving
         thread only, so the seen-count needs no lock)."""
         self._sync_chaos_metrics()
+        self._sync_journal_metrics()
         fr = utrace.flight_recorder()
         if fr is None or self.metrics is None:
             return

@@ -14,6 +14,10 @@ filters), and ``/debug/slo`` serves the per-pod latency SLO document
 (utils/slo.py: per-stage p50/p90/p99/p999 + worst-pod exemplars linking
 to the flight-recorder cycle and decision-audit entry; 404 while the
 tracker is disarmed, ``?stage=`` filters, bad parameters are 400).
+``/debug/journal`` reports the durable cycle journal's status
+(utils/journal.py: records, bytes, drops, window span, linkage
+hit-rates into the live flight/decision rings; ``armed: false`` when
+KUBETPU_JOURNAL is unset).
 """
 
 from __future__ import annotations
@@ -25,6 +29,7 @@ from dataclasses import asdict, is_dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from .utils import journal as ujournal
 from .utils import slo as uslo
 from .utils import trace as utrace
 
@@ -138,6 +143,28 @@ class SchedulerServer:
                     doc["exemplars"] = doc["exemplars"][:n]
                 self._send_json(200, doc)
 
+            def _journal(self, query) -> None:
+                jr = ujournal.journal()
+                if jr is None:
+                    self._send_json(200, {
+                        "armed": False,
+                        "hint": "arm with KUBETPU_JOURNAL=<dir> or "
+                                "kubetpu.utils.journal.arm_journal()"})
+                    return
+                fr = utrace.flight_recorder()
+                flight_seqs = ({r.seq for r in fr.cycles()}
+                               if fr is not None else None)
+                log = getattr(sched, "decisions", None)
+                decision_cycles = None
+                if log is not None and log.enabled:
+                    decision_cycles = {d.cycle
+                                       for d in log.recent(log.capacity)}
+                doc = jr.status(flight_seqs=flight_seqs,
+                                decision_cycles=decision_cycles)
+                doc["replay_hint"] = ("python -m tools.kubereplay "
+                                      + jr.dir)
+                self._send_json(200, doc)
+
             def do_GET(self):
                 parsed = urllib.parse.urlparse(self.path)
                 path = parsed.path
@@ -161,6 +188,8 @@ class SchedulerServer:
                     self._explain(query)
                 elif path == "/debug/slo":
                     self._slo(query)
+                elif path == "/debug/journal":
+                    self._journal(query)
                 else:
                     self._send(404, "not found")
 

@@ -58,11 +58,13 @@ node's size while the delta path keeps the last updated node's.
 from __future__ import annotations
 
 import os
+import pickle
 import time
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
+from ..utils import journal as ujournal
 from ..utils.intern import pow2_bucket
 from .tensors import (ClusterDelta, HostClusterArrays, SnapshotBuilder,
                       clear_pod_row, fill_node_row, fill_pod_row,
@@ -186,6 +188,28 @@ class DeltaTensorizer:
         self.cycles_since_verify = 0
         self.verify_count = 0
         self.divergence_count = 0
+        # cycle-journal capture seam (utils/journal.py): when the journal
+        # is armed, each refresh() stashes the exact input it applied to
+        # the resident cluster — ("resync", pickled mirror) on any full
+        # rebuild/re-upload, ("delta", pickled (ClusterDelta, terms)) on
+        # a scatter cycle, ("noop", None) on zero-dirty cycles — and the
+        # scheduler pops it into the cycle's journal record
+        # (take_capture).  Disarmed this stays None: zero allocations.
+        self.capture = None
+
+    def take_capture(self):
+        """Pop the last refresh()'s journal capture (None when the
+        journal is disarmed — the seam costs one attribute read)."""
+        cap, self.capture = self.capture, None
+        return cap
+
+    def _capture_resync(self) -> None:
+        """Serialize the freshly-uploaded mirror as a journal anchor
+        (armed only).  Pickled EAGERLY: later refreshes mutate the
+        mirror arrays in place, so a lazy reference would record the
+        wrong snapshot."""
+        if ujournal.journal() is not None:
+            self.capture = ("resync", pickle.dumps(self.host, protocol=4))
 
     # ------------------------------------------------------------- helpers
 
@@ -312,6 +336,10 @@ class DeltaTensorizer:
                  if ni.generation != self.node_gen.get(ni.node_name)]
         if not dirty:
             self.cycles_since_resync += 1
+            if ujournal.journal() is not None:
+                # zero-dirty: the journal records "previous cluster, as
+                # is" (a verify-divergence resync below overwrites this)
+                self.capture = ("noop", None)
             # the verifier ticks on zero-dirty cycles too: a corruption
             # injected by the LAST scatter must not hide behind a quiet
             # cluster until the next churn
@@ -436,6 +464,7 @@ class DeltaTensorizer:
             self.resync_count += 1
             t_build = time.time()
             self._upload()
+            self._capture_resync()
             return self.cluster, DeltaStats(
                 len(node_rows) + len(pod_rows), True, "pod-axis-growth",
                 (("delta-build", t0, t_build),) + term_span
@@ -492,6 +521,7 @@ class DeltaTensorizer:
         self.cycles_since_verify = 0
         self.resync_count += 1
         self._upload()
+        self._capture_resync()
         return self.cluster, DeltaStats(
             0, True, reason, (("resync", t0, time.time()),))
 
@@ -575,6 +605,19 @@ class DeltaTensorizer:
             # new cluster no longer uses anyway
             ft, st = self._device_terms()
             cluster = cluster._replace(filter_terms=ft, score_terms=st)
+        if ujournal.journal() is not None:
+            # journal capture: the exact scatter tables (and wholesale
+            # term replacement) this cycle applies — pickled eagerly, the
+            # mirror the term pytrees alias mutates in place next cycle.
+            # Captured BEFORE the chaos seam below: the journal records
+            # applied INTENT, so a chaos-dropped scatter replays as a
+            # detectable divergence (the fault class the replay rig
+            # exists to expose)
+            a = self.host.arrays
+            terms = ((a["filter_terms"], a["score_terms"])
+                     if replace_terms else None)
+            self.capture = ("delta", pickle.dumps((delta, terms),
+                                                  protocol=4))
         # chaos seam (utils/chaos.py "delta"): "drop" loses the scatter
         # entirely (the mirror was already refilled, so device and host
         # now silently diverge — the exact fault class the anti-entropy

@@ -29,6 +29,10 @@ Injection points threaded through the stack:
                  transport error
   ``watch``      client/rest.RestClusterStore._watch_loop — watch
                  disconnect (drives the capped-backoff reconnect)
+  ``journal``    utils/journal.CycleJournal.append — fail the record
+                 write ("error": degrade to a counted drop) or land a
+                 damaged frame on disk ("truncate"/"corrupt": the
+                 reader-side crc skips it with a per-record reason)
 
 Arming: ``KUBETPU_CHAOS=<spec>`` at import of the consumer (read by
 ``maybe_arm_from_env``), or programmatically (``arm(registry)``) for
@@ -70,6 +74,10 @@ POINTS: Dict[str, Tuple[str, ...]] = {
     "extender": ("error",),
     "rest": ("error",),
     "watch": ("error",),
+    # utils/journal.CycleJournal.append — "error" fails the write (the
+    # record degrades to a counted drop), "truncate"/"corrupt" land a
+    # damaged frame on disk (the reader-side crc skips it per record)
+    "journal": ("error", "truncate", "corrupt"),
 }
 
 DEFAULT_STALL_S = 0.05

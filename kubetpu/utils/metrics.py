@@ -281,6 +281,20 @@ class SchedulerMetrics:
             f"{p}_recoveries_total",
             "Self-healing recoveries performed by the runtime, by kind.",
             ("kind",)))
+        # durable cycle journal (utils/journal.py): records appended,
+        # bytes currently retained on disk, and records dropped — write
+        # failures AND size-cap evictions both count (never silent).
+        # Synced on the serving thread like the chaos counters.
+        self.journal_records = r(Counter(
+            f"{p}_journal_records_total",
+            "Cycle records appended to the durable journal."))
+        self.journal_bytes = r(Gauge(
+            f"{p}_journal_bytes",
+            "Bytes of cycle records currently retained by the journal."))
+        self.journal_dropped = r(Counter(
+            f"{p}_journal_dropped_total",
+            "Journal records dropped: write failures plus size-cap "
+            "evictions."))
 
     # hooks consumed by queue/scheduler ------------------------------------
 

@@ -155,7 +155,8 @@ class SloTracker:
     def observe_pod(self, stages: Dict[str, float], *, pod: str = "",
                     namespace: str = "", uid: str = "",
                     outcome: str = "bound", attempts: int = 0,
-                    cycle: int = 0, flight_seq: int = 0) -> None:
+                    cycle: int = 0, flight_seq: int = 0,
+                    journal_seq: int = 0) -> None:
         """Fold one terminal pod's per-stage latency vector in.  stages:
         stage name -> seconds (missing stages are simply not observed);
         an ``e2e`` key is the SLO number and drives exemplar ranking."""
@@ -181,10 +182,13 @@ class SloTracker:
                     "stages_s": {k: round(float(v), 6)
                                  for k, v in stages.items() if k != "e2e"},
                     # the cross-links: the flight-recorder cycle record
-                    # (/debug/flightz, CycleRecord.seq) and the decision
-                    # audit entry (/debug/explain?pod=) for this pod
+                    # (/debug/flightz, CycleRecord.seq), the decision
+                    # audit entry (/debug/explain?pod=) and — when
+                    # KUBETPU_JOURNAL is armed — the durable journal
+                    # record id tools/kubereplay can re-execute
                     "cycle": int(cycle),
                     "flight_seq": int(flight_seq),
+                    "journal_seq": int(journal_seq),
                     "explain": (f"/debug/explain?pod={pod}"
                                 f"&namespace={namespace}" if pod else ""),
                 }

@@ -358,6 +358,16 @@ class FlightRecorder:
         if trk is not None:
             doc["slo"] = {"stages": trk.stage_quantiles(),
                           "shares": trk.shares()}
+        # durable-journal digest (utils/journal.py): when the journal is
+        # armed alongside the recorder, the pipeline doc carries its
+        # status — records, bytes, drops, window span and the linkage
+        # hit-rate into THIS ring's live cycle seqs — so traceview can
+        # print the "journal:" digest from the committed artifact alone
+        from . import journal as _journal
+        jr = _journal.journal()
+        if jr is not None:
+            doc["journal"] = jr.status(
+                flight_seqs={r.seq for r in recs})
         return doc
 
     @staticmethod

@@ -22,15 +22,20 @@ python -m tools.kubelint kubetpu/ --json
 # annotated and observed from both the serving thread and binder pool.
 # The depth-k pipelined executor (kubetpu/pipeline.py) joins it too: its
 # in-flight ring is guarded-by annotated, and no device dispatch,
-# readback or sleep may ever run under the ring lock
+# readback or sleep may ever run under the ring lock.  The durable cycle
+# journal (utils/journal.py) joins it: its file-index/counter state is
+# guarded-by annotated and record I/O runs outside the lock
 python -m tools.kubelint kubetpu/utils/trace.py kubetpu/utils/decisions.py \
 	kubetpu/utils/chaos.py kubetpu/utils/slo.py kubetpu/pipeline.py \
+	kubetpu/utils/journal.py \
 	--rules concurrency --json
 # explicit delta-family pass over the serving loop: the cycle path must
 # stay scatter-only (full-retensorize-in-loop), independent of any
 # unrelated suppression elsewhere in the tree.  The pipelined executor
-# rides along — its drain is the cycle loop now
+# rides along — its drain is the cycle loop now.  journal.py rides too:
+# it reads the resident mirror at commit and must never re-tensorize
 python -m tools.kubelint kubetpu/scheduler.py kubetpu/pipeline.py \
+	kubetpu/utils/journal.py \
 	--rules delta --json
 # compile-surface census (tools/kubecensus): jaxpr-level abstract
 # interpretation of every jit root.  Fails on (a) any unsuppressed
@@ -76,6 +81,19 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
 # flight tags, and the flush semantics.
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
 	tests/test_pipeline.py -q -m 'not slow' -p no:cacheprovider
+# Durable cycle journal (kubetpu/utils/journal.py): record framing +
+# size-cap eviction counting, the chaos journal point's degrade-to-drop
+# write contract, the disarmed zero-lock poison test, and the
+# armed-vs-disarmed placement parity golden.
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
+	tests/test_journal.py -q -m 'not slow' -p no:cacheprovider
+# Bit-exact replay rig (tools/kubereplay): the journaled-drain replay
+# oracle (byte-identical packed placements incl. delta cycles, resyncs
+# and a depth-4 pipelined segment), per-record corrupt-skip reasons, and
+# the counterfactual contracts (score-weight nonzero / pipelineDepth
+# zero divergence).
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
+	tests/test_replay.py -q -m 'not slow' -p no:cacheprovider
 # Bench-trend CI check (tools/benchtrend.py, pure JSON, no jax): the
 # committed BENCH_r*/MULTICHIP_r* trajectory must stay schema-compatible
 # with the trend tooling, and the newest parseable round must not

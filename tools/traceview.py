@@ -175,6 +175,32 @@ def auction_summary(doc) -> str:
             + (f"; backend {b}" if b else "") + ")")
 
 
+def journal_summary(doc) -> str:
+    """One-line durable-journal digest under the stage table: record and
+    byte counts, drops, the recorded cycle window, and the linkage
+    hit-rates into the flight-recorder/decision rings — read from the
+    "journal" block the pipeline doc (or a /debug/journal dump) carries
+    when KUBETPU_JOURNAL was armed for the run (kubetpu/utils/
+    journal.py; replay with python -m tools.kubereplay <dir>)."""
+    j = doc.get("journal")
+    if not isinstance(j, dict) or not j.get("armed"):
+        return ""
+    kb = j.get("bytes", 0) / 1024.0
+    parts = [f"{j.get('records', 0)} records ({kb:.1f} KiB"
+             + (f", {j['dropped_total']} dropped"
+                if j.get("dropped_total") else "") + ")"]
+    span = j.get("cycle_span")
+    if span:
+        parts.append(f"cycles {span[0]}-{span[1]}")
+    if "flight_live_rate" in j:
+        parts.append(f"flight-link {100 * j['flight_live_rate']:.0f}%")
+    elif "flight_link_rate" in j:
+        parts.append(f"flight-link {100 * j['flight_link_rate']:.0f}%")
+    if "decision_live_rate" in j:
+        parts.append(f"decision-link {100 * j['decision_live_rate']:.0f}%")
+    return "journal: " + ", ".join(parts)
+
+
 def pipeline_summary(doc) -> str:
     """One-line depth-k pipeline digest under the stage table: the
     configured depth plus the ring-slot occupancy histogram (slot ->
@@ -257,6 +283,9 @@ def main(argv=None) -> int:
     slo = slo_summary(doc)
     if slo:
         print(slo)
+    jnl = journal_summary(doc)
+    if jnl:
+        print(jnl)
     if not spans:
         return 0
     wall: Dict[int, float] = {}
