@@ -4,7 +4,8 @@
 
 .PHONY: help lint lock-graph test sanitize-test race-test flight-test \
 	delta-test census census-test aot aot-test pallas-test chaos-test \
-	slo-test pipeline-test journal-test replay-test trend trace bench
+	slo-test pipeline-test journal-test replay-test devstats-test trend \
+	trace bench
 
 help:
 	@echo "kubetpu targets:"
@@ -70,6 +71,13 @@ help:
 	@echo "                      drain replays byte-identical, corrupt-"
 	@echo "                      record skip with reason, counterfactual"
 	@echo "                      score-weight/pipelineDepth divergence"
+	@echo "  make devstats-test  device-side observability suite"
+	@echo "                      (kubetpu/utils/devstats.py): sampled"
+	@echo "                      per-program device-time fences, roofline"
+	@echo "                      join vs COMPILE_MANIFEST.json, residency"
+	@echo "                      ledger + capacity-planner 10% sanity gate,"
+	@echo "                      /debug/devicez round trip, disarmed poison,"
+	@echo "                      armed-vs-disarmed placement parity"
 	@echo "  make trend          per-case bench trend table over the committed"
 	@echo "                      BENCH_r*.json trajectory with per-stage"
 	@echo "                      regression attribution (tools/benchtrend.py)"
@@ -187,6 +195,15 @@ journal-test:
 replay-test:
 	JAX_PLATFORMS=cpu python -m pytest \
 		tests/test_replay.py -q -m 'not slow' -p no:cacheprovider
+
+# device-side observability (kubetpu/utils/devstats.py): measured
+# per-program device time via sampled deep-timing fences, the roofline
+# join against the committed manifest cost rows, the HBM residency
+# ledger + the capacity planner's projection-vs-measured 10% gate, and
+# the house arming contract (disarmed poison, placement parity)
+devstats-test:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_devstats.py -q -p no:cacheprovider
 
 # bench trend table + regression attribution over the committed rounds
 trend:

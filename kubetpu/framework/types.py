@@ -17,6 +17,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..api import types as api
+from ..utils.trace import wallclock
 from ..api.resource import (DEFAULT_MEMORY_REQUEST, DEFAULT_MILLI_CPU_REQUEST,
                             Resource)
 
@@ -190,9 +191,12 @@ class QueuedPodInfo:
     """Queue bookkeeping for a pending pod.
     reference: types.go:43 (QueuedPodInfo)."""
     pod: api.Pod
-    timestamp: float = field(default_factory=time.time)
+    # wallclock (utils/trace.py), not time.time: these stamps anchor the
+    # SLO layer's queue_wait/backoff/e2e durations against scheduler-side
+    # wallclock stamps — the whole domain must share the monotonic clock
+    timestamp: float = field(default_factory=wallclock)
     attempts: int = 0
-    initial_attempt_timestamp: float = field(default_factory=time.time)
+    initial_attempt_timestamp: float = field(default_factory=wallclock)
     # queue.scheduling_cycle captured when this pod was popped (reference:
     # scheduler.go:515 podSchedulingCycle := SchedulingQueue.SchedulingCycle()
     # is read at pop time, not at failure time)

@@ -57,8 +57,9 @@ from __future__ import annotations
 
 import os
 import threading
-import time
 from typing import Dict, List, Optional, Tuple
+
+from .utils import trace as utrace
 
 PIPELINE_DEPTH_ENV = "KUBETPU_PIPELINE_DEPTH"
 DEFAULT_PIPELINE_DEPTH = 2
@@ -123,6 +124,13 @@ class InflightRing:
         with self._lock:
             return [p for p, _ in self._slots]
 
+    def results(self) -> List[object]:
+        """Device results of every in-flight slot (the devstats deep
+        fence pre-drains them UNTIMED so a sampled cycle's measurement
+        never includes older cycles' queued-ahead device work)."""
+        with self._lock:
+            return [r for _, r in self._slots]
+
     def park(self, now: float) -> None:
         """Stamp caller think time's start on every in-flight cycle —
         wall clock between ``schedule_pending`` calls is host time and
@@ -176,6 +184,11 @@ class PipelinedExecutor:
         withholding set ``_prepare_group`` consults."""
         return self.ring.preps()
 
+    def inflight_results(self) -> List[object]:
+        """Every in-flight slot's device result (see
+        InflightRing.results)."""
+        return self.ring.results()
+
     def pop_timeout(self, timeout: Optional[float]) -> Optional[float]:
         """Gate the queue's 20 ms burst-gather window on FREE pipeline
         slots: a full ring pops non-blocking (the oldest cycle's commit
@@ -207,7 +220,7 @@ class PipelinedExecutor:
         s = self.sched
         ring = self.ring
         returned: List = []
-        cycle_start = time.time()
+        cycle_start = utrace.wallclock()
         ring.unpark(cycle_start)
         while True:
             qpods = s.queue.pop_batch(max_batch,
@@ -232,8 +245,8 @@ class PipelinedExecutor:
                     outcomes = returned + self._commit_oldest()
                 if s.metrics and outcomes:
                     s.metrics.observe_cycle(len(outcomes),
-                                            time.time() - cycle_start)
-                ring.park(time.time())
+                                            utrace.wallclock() - cycle_start)
+                ring.park(utrace.wallclock())
                 return outcomes
             (name, group), = by_profile.items()
             fwk = s.profiles[name]
@@ -260,8 +273,8 @@ class PipelinedExecutor:
                 outcomes = returned + self.flush()
                 if s.metrics and outcomes:
                     s.metrics.observe_cycle(len(outcomes),
-                                            time.time() - cycle_start)
-                ring.park(time.time())
+                                            utrace.wallclock() - cycle_start)
+                ring.park(utrace.wallclock())
                 return outcomes
             if len(ring) and not prep.used_chain:
                 # chain break (event landed / vocab overflow / bucket
@@ -275,7 +288,7 @@ class PipelinedExecutor:
                 prep, early2 = self._reprepare(prep)
                 returned += early2
                 if prep is None:
-                    ring.park(time.time())
+                    ring.park(utrace.wallclock())
                     return returned
             # ring full: readback + commit the oldest slot around k's
             # dispatch.  The readback MUST precede the dispatch (the
@@ -283,9 +296,9 @@ class PipelinedExecutor:
             oldest = packed_oldest = None
             if len(ring) and ring.free() <= 0:
                 oldest = ring.pop_oldest()
-                t0 = time.time()
+                t0 = utrace.wallclock()
                 packed_oldest, rec_prev = s._readback_guarded(*oldest)
-                ring.exempt(time.time() - t0)
+                ring.exempt(utrace.wallclock() - t0)
                 if rec_prev is not None:
                     # the oldest's dispatch errored or blew its deadline:
                     # it was recovered (pods requeued, residents
@@ -298,7 +311,7 @@ class PipelinedExecutor:
                     prep, early2 = self._reprepare(prep)
                     returned += early2
                     if prep is None:
-                        ring.park(time.time())
+                        ring.park(utrace.wallclock())
                         return returned
             res = None
             with prep.trace.stage(
@@ -321,8 +334,8 @@ class PipelinedExecutor:
                 returned += self.flush()
                 if s.metrics and returned:
                     s.metrics.observe_cycle(len(returned),
-                                            time.time() - cycle_start)
-                ring.park(time.time())
+                                            utrace.wallclock() - cycle_start)
+                ring.park(utrace.wallclock())
                 return returned
             s._last_commit_failed = False
             if oldest is not None:
@@ -343,8 +356,8 @@ class PipelinedExecutor:
                     if prep is None:
                         if s.metrics and returned:
                             s.metrics.observe_cycle(
-                                len(returned), time.time() - cycle_start)
-                        ring.park(time.time())
+                                len(returned), utrace.wallclock() - cycle_start)
+                        ring.park(utrace.wallclock())
                         return returned
                     with prep.trace.stage("dispatch"):
                         try:
@@ -356,8 +369,8 @@ class PipelinedExecutor:
                             if s.metrics and returned:
                                 s.metrics.observe_cycle(
                                     len(returned),
-                                    time.time() - cycle_start)
-                            ring.park(time.time())
+                                    utrace.wallclock() - cycle_start)
+                            ring.park(utrace.wallclock())
                             return returned
             # ring-slot tag: which pipeline slot this cycle parked in
             # (0 = dispatched straight behind the commit) — traceview
@@ -375,15 +388,15 @@ class PipelinedExecutor:
                 if returned:
                     if s.metrics:
                         s.metrics.observe_cycle(len(returned),
-                                                time.time() - cycle_start)
+                                                utrace.wallclock() - cycle_start)
                     return returned
                 continue
             ring.append(prep, res)
             if returned:
                 if s.metrics:
                     s.metrics.observe_cycle(len(returned),
-                                            time.time() - cycle_start)
-                ring.park(time.time())
+                                            utrace.wallclock() - cycle_start)
+                ring.park(utrace.wallclock())
                 return returned
             # pipe still priming (cycles dispatched, nothing committed
             # yet): loop to pop the next batch so this call still returns
@@ -395,7 +408,7 @@ class PipelinedExecutor:
         """Commit every in-flight cycle, oldest first (shutdown, chain
         breaks, host-relevant serialization, and callers that need every
         outcome materialized now)."""
-        self.ring.unpark(time.time())
+        self.ring.unpark(utrace.wallclock())
         outs: List = []
         while len(self.ring):
             outs += self._commit_oldest()
@@ -414,9 +427,9 @@ class PipelinedExecutor:
         deadline) or a commit failure re-runs every younger in-flight
         cycle by scatter."""
         s = self.sched
-        t0 = time.time()
+        t0 = utrace.wallclock()
         packed, rec = s._readback_guarded(prep, res)
-        self.ring.exempt(time.time() - t0)
+        self.ring.exempt(utrace.wallclock() - t0)
         if packed is None:
             # recovered pre-commit: nothing was reserved or bound; the
             # younger in-flight cycles were built on its chain/residents
@@ -436,7 +449,7 @@ class PipelinedExecutor:
         cycle's commit-failed flag) — a failure re-runs every younger
         ring entry here; the caller handles the un-ringed cycle."""
         s = self.sched
-        t0 = time.time()
+        t0 = utrace.wallclock()
         with prep.trace.stage("commit"):
             outs = s._commit_group(prep, packed)
         failed = s._last_commit_failed
@@ -445,7 +458,7 @@ class PipelinedExecutor:
                               kernel_backend=s._gang_backend(prep))
         else:
             prep.trace.finish()
-        dt = time.time() - t0
+        dt = utrace.wallclock() - t0
         self.ring.exempt(dt)
         if exempt_prep is not None:
             exempt_prep.host_exempt_s += dt

@@ -880,7 +880,10 @@ class Preemptor:
         # flight_span attaches under the scheduler's open preemption-wave
         # span (utils/trace.py) — no-op when the recorder is disarmed
         with flight_span("whatif-readback", pods=B) as sp:
-            t_dev = time.time()
+            # perf_counter, not time.time(): the wait is a DURATION, and
+            # an NTP step mid-wave used to corrupt it (negative or wildly
+            # inflated device_wait_s in the span args)
+            t_dev = time.perf_counter()
             packed = np.asarray(programs.whatif_wave(
                 cluster, static_ok, jnp.asarray(np.asarray(batch.req)),
                 jnp.asarray(cand_rows), jnp.asarray(cand_valid), nom_dev,
@@ -889,7 +892,8 @@ class Preemptor:
             if sp is not None:
                 # wave device-wait attribution (the what-if dispatch +
                 # transfer is the wave's only device sync)
-                sp.args["device_wait_s"] = round(time.time() - t_dev, 6)
+                sp.args["device_wait_s"] = round(
+                    time.perf_counter() - t_dev, 6)
 
         # pickOneNode metrics, vectorized over the whole [B, C, K] block
         # (generic_scheduler.go:729 criteria 1-5; criterion 6 = first in

@@ -30,6 +30,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..api import types as api
 from ..framework.types import QueuedPodInfo, pod_with_affinity
 from ..utils import slo as uslo
+from ..utils.trace import wallclock
 from .heap import Heap
 
 DEFAULT_POD_INITIAL_BACKOFF = 1.0   # reference: scheduler.go:205
@@ -117,7 +118,12 @@ class SchedulingQueue(PodNominator):
                  sort_key: Callable[[QueuedPodInfo], tuple] = default_sort_key,
                  pod_initial_backoff: float = DEFAULT_POD_INITIAL_BACKOFF,
                  pod_max_backoff: float = DEFAULT_POD_MAX_BACKOFF,
-                 clock: Callable[[], float] = time.time,
+                 # wallclock, not time.time: every queue stamp is one
+                 # end of an SLO/backoff DURATION (queue_wait, backoff,
+                 # cycle_wait, e2e) whose other end is a scheduler-side
+                 # wallclock stamp — an NTP step must not corrupt them.
+                 # Tests can still inject a fake clock.
+                 clock: Callable[[], float] = wallclock,
                  metrics=None):
         super().__init__()
         self._clock = clock

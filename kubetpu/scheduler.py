@@ -46,6 +46,7 @@ from .state.cache import SchedulerCache, Snapshot
 from .state.delta import DeltaTensorizer
 from .state.tensors import SnapshotBuilder
 from .utils import chaos as uchaos
+from .utils import devstats as udevstats
 from .utils import journal as ujournal
 from .utils import slo as uslo
 from .utils import trace as utrace
@@ -131,6 +132,15 @@ class PreparedCycle:
     journal_rng: int = 0
     journal_start: int = 0
     ring_slot: int = 0
+    # devstats deep-timing marker (utils/devstats.py): True when this
+    # cycle's dispatch was micro-fenced — the commit side then pairs
+    # the cycle's analytic FLOP count with the measured device seconds.
+    # The fence's own seconds ride along explicitly: at sampling
+    # intervals below the pipeline depth, newer samples land before
+    # this cycle's commit runs, so "the program's last sample" would be
+    # the wrong one
+    devstats_fenced: bool = False
+    devstats_fence_s: float = 0.0
 
 
 class Scheduler:
@@ -161,6 +171,12 @@ class Scheduler:
         # self-contained replayable record; disarmed, every seam is one
         # attribute read (tests/test_journal.py poison test)
         ujournal.maybe_arm_from_env()
+        # KUBETPU_DEVSTATS: arm device-side observability
+        # (utils/devstats.py) — sampled per-program device-time fences,
+        # the HBM residency ledger, roofline attribution; disarmed,
+        # every seam is one attribute read and placements are
+        # bit-identical armed vs disarmed (tests/test_devstats.py)
+        udevstats.maybe_arm_from_env()
         import jax
         self.store = store
         self.config = config or KubeSchedulerConfiguration(
@@ -311,6 +327,11 @@ class Scheduler:
         # only): a failed commit invalidates the speculative chain and
         # every in-flight cycle dispatched against it
         self._last_commit_failed = False
+        # devstats chain-ledger memo (serving thread only): the chain
+        # registration re-runs only when (profile, pads, n_nodes)
+        # change — re-walking identical shapes every chained cycle
+        # would tax the armed serving thread for nothing
+        self._chain_ledger_key = None
         # (pod-axis bucket, compile-or-load seconds) per prewarmed program
         self.prewarm_report: List[Tuple[int, float]] = []
         self._bind_pool = ThreadPoolExecutor(max_workers=16,
@@ -417,6 +438,17 @@ class Scheduler:
         with self._chain_lock:
             self._chain_seq += 1
 
+    def _drop_chain_residency(self) -> None:
+        """Residency-ledger seam (utils/devstats.py): the speculative
+        chain was discarded, so its materialized cluster is no longer
+        device-resident — the capacity planner must stop counting it.
+        Disarmed: one attribute read.  Called OUTSIDE _chain_lock (the
+        devstats lock never nests with it)."""
+        ds = udevstats.devstats()
+        if ds is not None:
+            ds.drop_group("chain")
+            self._chain_ledger_key = None
+
     def _chain_enabled(self, fwk) -> bool:
         return (self.config.mode == "gang" and self._mesh is None
                 and getattr(self.config, "chain_cycles", False))
@@ -488,7 +520,7 @@ class Scheduler:
         return self._pipeline.flush()
 
     def _schedule_batch(self, qpods: List[QueuedPodInfo]) -> List[ScheduleOutcome]:
-        start = time.time()
+        start = utrace.wallclock()
         # group by profile: one device program per framework config
         outcomes: List[ScheduleOutcome] = []
         by_profile: Dict[str, List[QueuedPodInfo]] = {}
@@ -500,7 +532,8 @@ class Scheduler:
             fwk = self.profiles[name]
             outcomes.extend(self._schedule_group(fwk, group))
         if self.metrics:
-            self.metrics.observe_cycle(len(outcomes), time.time() - start)
+            self.metrics.observe_cycle(len(outcomes),
+                                       utrace.wallclock() - start)
         return outcomes
 
     def _skip_pod_schedule(self, pod: api.Pod) -> bool:
@@ -576,6 +609,24 @@ class Scheduler:
                   if utrace.flight_recorder() is not None else None)
         trace = Trace("Scheduling", profile=fwk.profile_name,
                       pods=len(qpods), queue_depths=depths)
+        # devstats cycle tick: every Nth cycle is a deep-timing cycle —
+        # its device dispatches (delta scatter below, the auction in
+        # _dispatch_group) are micro-fenced so per-program device time
+        # is measured even under depth-k overlap.  Disarmed: one read
+        ds = udevstats.devstats()
+        if ds is not None and ds.begin_cycle():
+            # pre-drain queued-ahead device work UNTIMED: at depth > 2
+            # older in-flight cycles are still executing, and the device
+            # runs programs in order — without this the fence would
+            # charge their remaining seconds to THIS cycle's programs.
+            # Completion is observed by READBACK (np.asarray), not
+            # block_until_ready — the axon tunnel does not block the
+            # latter; packed is tiny, and re-reading it later is safe
+            for res_old in self._pipeline.inflight_results():
+                try:
+                    np.asarray(res_old.packed)
+                except Exception:
+                    pass   # its own readback path recovers the fault
         # capture the event sequence BEFORE snapshotting: a chain is only
         # reusable if no event has landed since the state it embeds
         with self._chain_lock:
@@ -732,6 +783,7 @@ class Scheduler:
                 self._journal_force_anchor.discard(fwk.profile_name)
             with self._chain_lock:
                 self._chain = None
+            self._drop_chain_residency()
         spread_sels = [self.store.default_spread_selector(pi.pod)
                        for pi in pinfos]
         pb = PodBatchBuilder(builder.table)
@@ -917,8 +969,11 @@ class Scheduler:
         # deadline-guard anchor + chaos seam (utils/chaos.py "dispatch"):
         # an injected error models the device dying under the program; an
         # injected stall models a hung tunnel — both recovered by
-        # _recover_cycle via the guarded call sites / readback
-        prep.dispatch_t0 = time.time()
+        # _recover_cycle via the guarded call sites / readback.
+        # wallclock (utils/trace.py): the deadline and the SLO dispatch
+        # stage are durations-by-subtraction — an NTP step must not
+        # corrupt them
+        prep.dispatch_t0 = utrace.wallclock()
         if self._dispatch_deadline > 0:
             # idempotent singleton; first call installs the
             # jax.monitoring listener, later calls are a lock + read
@@ -967,6 +1022,36 @@ class Scheduler:
                     host_ok=host_ok_dev,
                     start_index=start,
                     score_bias=prep.score_bias)
+        # devstats deep-timing micro-fence (utils/devstats.py): on the
+        # sampled cycles, block until the dispatched program completes
+        # and record the wall seconds as MEASURED per-program device
+        # time — the only number that stays honest under depth-k
+        # overlap, where device_wait_s (the readback block) reads near
+        # zero.  The fence serializes work the pipeline would have
+        # hidden, so it runs on 1/N cycles and its cumulative cost is
+        # recorded (fence_wait_s).  Disarmed: one attribute read.
+        ds = udevstats.devstats()
+        if ds is not None and ds.deep_active():
+            program = ("run_auction" if self.config.mode == "gang"
+                       else "schedule_sequential")
+            with utrace.flight_span("device-fence", program=program) as sp:
+                # fence = a readback of the tiny packed vector, NOT
+                # block_until_ready: the axon tunnel does not block the
+                # latter (it would measure dispatch only); the readback
+                # is the one real completion signal on every backend.
+                # Its fixed tunnel latency is part of the recorded fence
+                # overhead, and re-reading packed in _readback_group is
+                # safe (transfers are non-destructive)
+                t_f = time.perf_counter()
+                np.asarray(res.packed)
+                dt_f = time.perf_counter() - t_f
+                if sp is not None:
+                    sp.args["device_time_s"] = round(dt_f, 6)
+            prep.devstats_fenced = True
+            prep.devstats_fence_s = dt_f
+            ds.record_program(
+                program, dt_f, source="fence",
+                in_bytes=udevstats.pytree_nbytes((cluster, batch)))
         if ujournal.journal() is not None:
             # journal provenance: the RNG fold counter this dispatch
             # consumed (_next_rng bumped it inside the call above) and
@@ -1032,9 +1117,30 @@ class Scheduler:
                                    # this cluster bit-exactly
                                    pads=(pow2_bucket(p_next),
                                          pow2_bucket(e_next)))
+            # residency-ledger seam (utils/devstats.py): the speculative
+            # chain is a SECOND full cluster resident until the next
+            # cycle consumes it — the capacity planner must count it.
+            # Memoized on (profile, pads, n_nodes): identical shapes
+            # register identical bytes, so the per-table walk runs only
+            # when the pad buckets actually move
+            ds = udevstats.devstats()
+            if ds is not None:
+                lkey = (fwk.profile_name, pow2_bucket(p_next),
+                        pow2_bucket(e_next), n_nodes)
+                # the has_group check backstops a bind-thread discard
+                # racing this registration (the memo alone could read
+                # fresh while the entry was just dropped)
+                if self._chain_ledger_key != lkey \
+                        or not ds.has_group("chain"):
+                    udevstats.register_cluster(
+                        "chain", fwk.profile_name, next_cluster, n_nodes,
+                        meta={"pads": [pow2_bucket(p_next),
+                                       pow2_bucket(e_next)]})
+                    self._chain_ledger_key = lkey
         elif self.config.mode == "gang":
             with self._chain_lock:
                 self._chain = None
+            self._drop_chain_residency()
         return res
 
     # ----------------------------------------------------------- recovery
@@ -1087,6 +1193,7 @@ class Scheduler:
         with self._chain_lock:
             self._chain = None
             self._chain_seq += 1
+        self._drop_chain_residency()
         self._delta.pop(prep.fwk.profile_name, None)
         for qp in prep.live:
             try:
@@ -1122,7 +1229,7 @@ class Scheduler:
         if prep.parked_t:
             # time parked in the in-flight ring = caller think time
             # between schedule_pending calls — exempt from the deadline
-            prep.host_exempt_s += time.time() - prep.parked_t
+            prep.host_exempt_s += utrace.wallclock() - prep.parked_t
             prep.parked_t = 0.0
         try:
             packed = self._readback_group(prep, res)
@@ -1135,7 +1242,7 @@ class Scheduler:
             if self._deadline_grace > 0:
                 self._deadline_grace -= 1
             else:
-                elapsed = (time.time() - prep.dispatch_t0
+                elapsed = (utrace.wallclock() - prep.dispatch_t0
                            - prep.host_exempt_s)
                 compiled = False
                 if prep.compile_snap is not None:
@@ -1203,9 +1310,9 @@ class Scheduler:
         one small array — the big tensors (requested, masks) stay on
         device for chaining / lazy preemption verdicts."""
         with prep.trace.stage("packed-readback") as sp:
-            t_dev = time.time()
+            t_dev = utrace.wallclock()
             packed = np.asarray(res.packed)
-            t_done = time.time()
+            t_done = utrace.wallclock()
             wait = t_done - t_dev
             prep.readback_done_t = t_done
             prep.device_wait = wait
@@ -1231,10 +1338,20 @@ class Scheduler:
             # auction round count (diagnostics; bench reports it)
             self.last_gang_rounds = int(packed[3 * B])
             from .utils.flops import gang_cycle_flops
-            self.device_flops += gang_cycle_flops(
+            cyc_flops = gang_cycle_flops(
                 prep.cluster, prep.batch, prep.cfg, self.last_gang_rounds,
                 intra_batch_topology=prep.needs_topo,
                 kernel_backend=self._gang_backend(prep))
+            self.device_flops += cyc_flops
+            if prep.devstats_fenced:
+                # pair the cycle's analytic FLOP count with ITS OWN
+                # fence's measured seconds (the round count — and so the
+                # FLOPs — is only known after the readback, and newer
+                # fence samples may have landed since)
+                ds = udevstats.devstats()
+                if ds is not None:
+                    ds.attribute_flops("run_auction", cyc_flops,
+                                       seconds=prep.devstats_fence_s)
         # one .tolist() per field: the commit loop below reads every entry,
         # and plain Python ints beat a numpy scalar box per access at 4k
         # pods/cycle (kubelint host-sync audit)
@@ -1386,6 +1503,7 @@ class Scheduler:
         if commit_failed and self.config.mode == "gang":
             with self._chain_lock:
                 self._chain = None
+            self._drop_chain_residency()
         if jr is not None:
             # one self-contained replayable record per committed cycle;
             # ANY failure (unpicklable capture, disk, injected chaos)
@@ -1434,7 +1552,7 @@ class Scheduler:
         the terminal stages — commit (readback -> bind start, or ->
         now for failures), bind (when one ran), e2e — and record it.
         The ONLY consumer of the prefix's underscore meta keys."""
-        now = time.time()
+        now = utrace.wallclock()
         stages = dict(prefix)
         seq = stages.pop("_flight_seq", 0)
         jseq = stages.pop("_journal_seq", 0)
@@ -1965,7 +2083,7 @@ class Scheduler:
             fwk.run_unreserve_plugins(state, pod, node_name)
             self._record_failure(fwk, qp, st.message())
             return st.message() or "prebind failed"
-        bind_start = time.time()
+        bind_start = utrace.wallclock()
         if binder_override is not None:
             # extender binding (reference: scheduler.go:457 extendersBinding)
             try:
@@ -2027,7 +2145,7 @@ class Scheduler:
         self.cache.finish_binding(assumed)
         fwk.run_post_bind_plugins(state, pod, node_name)
         if self.metrics:
-            now = time.time()
+            now = utrace.wallclock()
             self.metrics.binding_duration.observe(now - bind_start)
             self.metrics.pod_scheduled(
                 qp.attempts, now - qp.initial_attempt_timestamp,
@@ -2051,6 +2169,7 @@ class Scheduler:
         with self._chain_lock:
             self._chain = None
             self._chain_seq += 1
+        self._drop_chain_residency()
         try:
             self.cache.forget_pod(assumed)
         except ValueError:
@@ -2132,15 +2251,32 @@ class Scheduler:
         bumps scheduler_framework_rejections_total{plugin} for each pod's
         blocking plugin(s).  Any failure degrades to no attribution — the
         audit must never fail a cycle."""
+        ds = udevstats.devstats()
+        t_ev = 0.0
         try:
-            packed = np.asarray(programs.explain_verdicts(
-                prep.cluster, prep.batch, prep.cfg, prep.host_ok_dev))
+            # devstats timer starts AFTER the jitted call returns (the
+            # dispatch is async but trace/compile happen synchronously
+            # inside it — a first-call compile must not pollute the
+            # measured device time, same discipline as the fence)
+            out_dev = programs.explain_verdicts(
+                prep.cluster, prep.batch, prep.cfg, prep.host_ok_dev)
+            t_ev = time.perf_counter() if ds is not None else 0.0
+            packed = np.asarray(out_dev)
         except Exception:
             import logging
             logging.getLogger("kubetpu").warning(
                 "decision audit failed; failures recorded unattributed",
                 exc_info=True)
             return {}
+        if ds is not None and t_ev:
+            # the audit's packed readback is already a natural device
+            # sync, so the per-program measurement is free — recorded
+            # on every armed failure cycle, no fence needed
+            ds.record_program(
+                "explain_verdicts", time.perf_counter() - t_ev,
+                source="sync",
+                in_bytes=udevstats.pytree_nbytes((prep.cluster,
+                                                  prep.batch)))
         filters = prep.cfg.filters
         F = len(filters)
         counts = packed[:F].tolist()
@@ -2466,6 +2602,14 @@ class Scheduler:
             self.prewarm_report.append(
                 (int(cluster.pod_valid.shape[0]),
                  round(time.time() - t0, 2)))
+            # residency-ledger seam (utils/devstats.py): the ladder's
+            # dry-run clusters are live HBM until GC — register the
+            # deepest rung so restart-time residency is accountable
+            if udevstats.devstats() is not None:
+                udevstats.register_cluster(
+                    "prewarm-ladder", fwk.profile_name, cluster,
+                    int(cluster.allocatable.shape[0]),
+                    meta={"bucket": int(cluster.pod_valid.shape[0])})
 
     def _prewarm_ladder_step(self, fwk, cluster, batch, cfg, rng, res,
                              warm_bias, p_next, e_next):

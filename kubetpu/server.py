@@ -17,7 +17,11 @@ tracker is disarmed, ``?stage=`` filters, bad parameters are 400).
 ``/debug/journal`` reports the durable cycle journal's status
 (utils/journal.py: records, bytes, drops, window span, linkage
 hit-rates into the live flight/decision rings; ``armed: false`` when
-KUBETPU_JOURNAL is unset).
+KUBETPU_JOURNAL is unset).  ``/debug/devicez`` serves device-side
+observability (utils/devstats.py: measured per-program device time with
+the roofline join, the HBM residency ledger, fence-overhead accounting;
+404 while KUBETPU_DEVSTATS is disarmed, ``?program=`` filters, unknown
+programs are 400).
 """
 
 from __future__ import annotations
@@ -29,6 +33,7 @@ from dataclasses import asdict, is_dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from .utils import devstats as udevstats
 from .utils import journal as ujournal
 from .utils import slo as uslo
 from .utils import trace as utrace
@@ -143,6 +148,26 @@ class SchedulerServer:
                     doc["exemplars"] = doc["exemplars"][:n]
                 self._send_json(200, doc)
 
+            def _devicez(self, query) -> None:
+                ds = udevstats.devstats()
+                if ds is None:
+                    self._send_json(404, {
+                        "armed": False,
+                        "error": "device-side observability is disarmed",
+                        "hint": "arm with KUBETPU_DEVSTATS=1 or "
+                                "kubetpu.utils.devstats.arm_devstats()"})
+                    return
+                doc = ds.to_dict()
+                program = (query.get("program") or [None])[0]
+                if program is not None:
+                    if program not in doc["programs"]:
+                        self._send_json(400, {
+                            "error": f"unknown program {program!r}",
+                            "programs": sorted(doc["programs"])})
+                        return
+                    doc["programs"] = {program: doc["programs"][program]}
+                self._send_json(200, doc)
+
             def _journal(self, query) -> None:
                 jr = ujournal.journal()
                 if jr is None:
@@ -190,6 +215,8 @@ class SchedulerServer:
                     self._slo(query)
                 elif path == "/debug/journal":
                     self._journal(query)
+                elif path == "/debug/devicez":
+                    self._devicez(query)
                 else:
                     self._send(404, "not found")
 

@@ -64,8 +64,10 @@ from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
+from ..utils import devstats as udevstats
 from ..utils import journal as ujournal
 from ..utils.intern import pow2_bucket
+from ..utils.trace import wallclock
 from .tensors import (ClusterDelta, HostClusterArrays, SnapshotBuilder,
                       clear_pod_row, fill_node_row, fill_pod_row,
                       gather_delta, pod_has_terms, vocab_signature)
@@ -291,16 +293,16 @@ class DeltaTensorizer:
         if self.cycles_since_verify < self.verify_interval:
             return (), None
         self.cycles_since_verify = 0
-        tv = time.time()
+        tv = wallclock()
         ok = self.verify()
-        span = (("verify", tv, time.time()),)
+        span = (("verify", tv, wallclock()),)
         if ok:
             return span, None
         # divergence: the mirror is the source of truth (refilled from
         # NodeInfos each cycle), so the targeted repair is the blessed
         # full resync — re-derives and re-uploads everything
         _cluster, stats = self._resync(node_infos, names,
-                                       "verify-divergence", time.time(),
+                                       "verify-divergence", wallclock(),
                                        pending)
         return span, stats._replace(spans=span + stats.spans)
 
@@ -314,7 +316,7 @@ class DeltaTensorizer:
         re-interns them into its fresh table).  donate=False keeps the
         previous device buffers alive (an in-flight pipelined cycle still
         reads them)."""
-        t0 = time.time()
+        t0 = wallclock()
         if pending:
             self.builder.intern_pending(pending)
         names = [ni.node_name for ni in node_infos]
@@ -452,9 +454,9 @@ class DeltaTensorizer:
 
         term_span = ()
         if terms_dirty:
-            t_terms = time.time()
+            t_terms = wallclock()
             self._refresh_terms(node_infos)
-            term_span = (("delta-terms", t_terms, time.time()),)
+            term_span = (("delta-terms", t_terms, wallclock()),)
 
         pod_rows = sorted(touched_pods)
         if grown:
@@ -462,20 +464,24 @@ class DeltaTensorizer:
             # re-upload the (already-updated) mirror — no build() walk
             self.cycles_since_resync = 0
             self.resync_count += 1
-            t_build = time.time()
+            t_build = wallclock()
             self._upload()
             self._capture_resync()
             return self.cluster, DeltaStats(
                 len(node_rows) + len(pod_rows), True, "pod-axis-growth",
                 (("delta-build", t0, t_build),) + term_span
-                + (("resync", t_build, time.time()),))
+                + (("resync", t_build, wallclock()),))
         delta = gather_delta(self.host, node_rows, pod_rows)
-        t_build = time.time()
+        t_build = wallclock()
         self.cluster = self._apply(delta, donate=donate,
                                    replace_terms=terms_dirty)
+        if terms_dirty:
+            # wholesale term replacement can change the term-table
+            # shapes — the only delta-path event that moves residency
+            self._register_residency()
         self.cycles_since_resync += 1
         spans = ((("delta-build", t0, t_build),) + term_span
-                 + (("delta-apply", t_build, time.time()),))
+                 + (("delta-apply", t_build, wallclock()),))
         vspan, vstats = self._verify_tick(node_infos, names, pending)
         if vstats is not None:
             return self.cluster, vstats._replace(spans=spans
@@ -523,7 +529,7 @@ class DeltaTensorizer:
         self._upload()
         self._capture_resync()
         return self.cluster, DeltaStats(
-            0, True, reason, (("resync", t0, time.time()),))
+            0, True, reason, (("resync", t0, wallclock()),))
 
     def _grow_pod_axis(self, needed: int) -> None:
         """Pad the mirror's pod-axis arrays to the next pow2 bucket —
@@ -549,6 +555,19 @@ class DeltaTensorizer:
             from ..parallel import mesh as pmesh
             cluster = pmesh.shard_cluster(cluster, self.mesh)
         self.cluster = cluster
+        self._register_residency()
+
+    def _register_residency(self) -> None:
+        """Residency-ledger seam (utils/devstats.py): register the
+        resident cluster's per-table bytes under this profile — the
+        shape walk happens only when residency can have CHANGED (resync,
+        pod-axis growth, wholesale term replacement; scatters keep
+        shapes).  Disarmed: one attribute read."""
+        if udevstats.devstats() is None or self.cluster is None:
+            return
+        udevstats.register_cluster(
+            "delta-resident", self.profile or "default", self.cluster,
+            len(self.node_names), meta={"resyncs": self.resync_count})
 
     def _refresh_terms(self, node_infos) -> None:
         """Term-only rebuild: walk the term OWNERS (a small subset of the
@@ -635,4 +654,20 @@ class DeltaTensorizer:
                                                donate=donate)
         if act == "corrupt":
             new = new._replace(requested=new.requested.at[0, 0].add(1.0))
+        ds = udevstats.devstats()
+        if ds is not None and ds.deep_active():
+            # deep-timing micro-fence (utils/devstats.py): on the
+            # sampled cycles, measure the scatter's actual device time —
+            # normally it completes invisibly behind the auction's
+            # dispatch.  Completion is observed by reading back ONE
+            # small output ([N] node_valid — a single executable's
+            # outputs complete together), not block_until_ready, which
+            # the axon tunnel does not block.  Waiting changes no value
+            # (armed-vs-disarmed parity golden); the overhead is
+            # counted in fence_wait_s
+            t_f = time.perf_counter()
+            np.asarray(new.node_valid)
+            ds.record_program("apply_cluster_delta",
+                              time.perf_counter() - t_f, source="fence",
+                              in_bytes=udevstats.pytree_nbytes(delta))
         return new

@@ -24,9 +24,14 @@ unresolvable) pod lands here as a per-stage latency vector:
                up to k overlapping dispatch->readback windows, and
                without it every overlapped second would be counted once
                per in-flight cycle, swamping ``stage_shares``
-  device       the cycle's packed-readback block (``device_wait_s`` —
-               the only point device completion is observable; every pod
-               of a cycle shares the cycle's value)
+  device       the cycle's packed-readback block (``device_wait_s``;
+               every pod of a cycle shares the cycle's value).  NOTE:
+               this is READBACK-BLOCK host time, not measured device
+               time — under the depth-k pipeline, device execution
+               overlaps host work and this stage reads near zero even
+               when the device is saturated.  MEASURED per-program
+               device time (honest at any depth) lives in
+               utils/devstats.py (KUBETPU_DEVSTATS, /debug/devicez).
   commit       readback done -> this pod's placement committed
   bind         PreBind/Bind/PostBind wall time (binder thread)
   e2e          first attempt -> bound (the SLO number)

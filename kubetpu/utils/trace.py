@@ -43,6 +43,24 @@ LOG = logging.getLogger("kubetpu.trace")
 
 SLOW_CYCLE_THRESHOLD = 0.1  # 100 ms (generic_scheduler.go:148 LogIfLong)
 
+# Monotonic wall clock: perf_counter deltas anchored to the process's
+# wall epoch, captured ONCE at import.  Every span/duration stamp in
+# this module (and the scheduler's dispatch-deadline / device-wait
+# domain) reads wallclock() instead of time.time(): an NTP step moves
+# time.time() but not perf_counter, so a step mid-cycle used to corrupt
+# device_wait_s and every span length (negative durations, bogus
+# deadline trips).  The epoch anchor keeps the values wall-meaningful —
+# Perfetto `ts` microseconds still line up with real time — while
+# durations-by-subtraction stay strictly monotonic.
+_WALL_EPOCH = time.time() - time.perf_counter()
+
+
+def wallclock() -> float:
+    """time.time()-compatible timestamp that can never run backwards
+    (see _WALL_EPOCH).  Use for any pair of stamps whose DIFFERENCE is
+    a duration."""
+    return _WALL_EPOCH + time.perf_counter()
+
 FLIGHT_ENV = "KUBETPU_FLIGHT"
 FLIGHT_N_ENV = "KUBETPU_FLIGHT_N"
 FLIGHT_SPANS_ENV = "KUBETPU_FLIGHT_SPANS"
@@ -73,6 +91,14 @@ def capture_device_trace(log_dir: str):
     finally:
         _PROFILE_ACTIVE = False
         jax.profiler.stop_trace()
+        # devstats xplane hook: when device-side observability is armed,
+        # fold the capture into per-program device-time records (or
+        # record WHY the tooling can't — never silently); disarmed this
+        # is one attribute read
+        from . import devstats as _devstats
+        ds = _devstats.devstats()
+        if ds is not None:
+            ds.ingest_xplane(log_dir)
 
 
 # --------------------------------------------------------------------- spans
@@ -145,7 +171,7 @@ class CycleRecord:
                  max_spans: int = DEFAULT_FLIGHT_SPANS):
         self.seq = seq
         self.label = label
-        self.t0 = time.time()
+        self.t0 = wallclock()
         self.t1: Optional[float] = None
         self.queue_depths = dict(queue_depths or {})
         self.meta: Dict[str, Any] = dict(fields or {})
@@ -170,7 +196,7 @@ class CycleRecord:
                 self.span_drops += 1
                 return None
             span = FlightSpan(self._next_id, parent_id, name, thread,
-                              t0 if t0 is not None else time.time(),
+                              t0 if t0 is not None else wallclock(),
                               args=args or {})
             self._next_id += 1
             self._spans.append(span)
@@ -180,7 +206,7 @@ class CycleRecord:
     def end_span(span: Optional[FlightSpan],
                  t1: Optional[float] = None) -> None:
         if span is not None:
-            span.t1 = t1 if t1 is not None else time.time()
+            span.t1 = t1 if t1 is not None else wallclock()
 
     def record_span(self, name: str, t0: float, t1: float,
                     parent_id: int = 0, **args) -> Optional[FlightSpan]:
@@ -194,7 +220,7 @@ class CycleRecord:
         """Record an instant event (ph "i" in the Chrome export) — used
         for recompiles fed by the sanitize watchdog.  Capped like spans
         (a recompile storm must not balloon the record); drops count."""
-        ev = {"name": name, "ts": time.time(), "parent": parent_id,
+        ev = {"name": name, "ts": wallclock(), "parent": parent_id,
               "thread": threading.current_thread().name,
               "args": dict(args)}
         with self._lock:
@@ -281,7 +307,7 @@ class FlightRecorder:
         """Push a finished record into the ring, dropping (and counting)
         the oldest when full."""
         if rec.t1 is None:
-            rec.t1 = time.time()
+            rec.t1 = wallclock()
         with self._lock:
             self._ring.append(rec)
             while len(self._ring) > self.capacity:
@@ -368,6 +394,15 @@ class FlightRecorder:
         if jr is not None:
             doc["journal"] = jr.status(
                 flight_seqs={r.seq for r in recs})
+        # device-side observability digest (utils/devstats.py): when
+        # armed alongside the recorder, the pipeline doc carries the
+        # measured per-program device times + roofline join and the
+        # residency-ledger totals so traceview can print the "device:"
+        # digest from the committed artifact alone
+        from . import devstats as _devstats
+        ds = _devstats.devstats()
+        if ds is not None:
+            doc["device"] = ds.summary()
         return doc
 
     @staticmethod
@@ -539,7 +574,7 @@ class Trace:
                  queue_depths: Optional[Dict[str, int]] = None, **fields):
         self.name = name
         self.fields = fields
-        self.start = time.time()
+        self.start = wallclock()
         self.steps: List[Tuple[float, str]] = []
         self.thread = threading.current_thread().name
         self._ann = None
@@ -576,7 +611,7 @@ class Trace:
             self._ann.__enter__()
 
     def step(self, msg: str) -> None:
-        now = time.time()
+        now = wallclock()
         self.steps.append((now, msg))
         if self.rec is not None:
             # the interval since the previous mark becomes a child span
@@ -606,7 +641,7 @@ class Trace:
         if meta:
             rec.meta.update(meta)
         CycleRecord.end_span(self._root)
-        rec.t1 = time.time()
+        rec.t1 = wallclock()
         fr.commit_cycle(rec)
 
     def __del__(self):
@@ -624,7 +659,7 @@ class Trace:
             pass
 
     def total(self) -> float:
-        return time.time() - self.start
+        return wallclock() - self.start
 
     def log_if_long(self, threshold: float = SLOW_CYCLE_THRESHOLD) -> Optional[str]:
         self._close_annotation()

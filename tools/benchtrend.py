@@ -13,7 +13,9 @@ a fresh run (``--run`` pointing at a BENCH_OUT-format file), and prints:
   * for every case whose throughput regressed beyond the threshold,
     WHICH STAGE's latency share grew — the stage_shares diff when both
     rounds carry the latency block, the host_share/device_wait split
-    otherwise.
+    otherwise — and, when both rounds carry a devstats ``device`` block
+    (kubetpu/utils/devstats.py), WHICH PROGRAM regressed: the one whose
+    achieved roofline fraction fell, or whose resident HBM grew.
 
 ``--check`` is the CI mode (tools/ci_lint.sh): nonzero exit when a
 committed artifact is schema-INCOMPATIBLE (a case present but
@@ -144,18 +146,68 @@ def row_unit(cases: List[Dict[str, Any]]) -> str:
     return "s"
 
 
+def device_attribution(prev: Dict[str, Any],
+                       cur: Dict[str, Any]) -> str:
+    """Device-side half of the attribution (the devstats ``device``
+    block, kubetpu/utils/devstats.py): name the PROGRAM whose achieved
+    roofline fraction fell the most — or slowed the most when neither
+    round carries a roofline join — and whether resident HBM grew, so a
+    regression reads "run_auction's achieved fraction fell" instead of
+    just "the device stage grew"."""
+    dp = prev.get("device") or {}
+    dc = cur.get("device") or {}
+    pp, pc = dp.get("programs") or {}, dc.get("programs") or {}
+    notes = []
+    worst = None
+    for name in sorted(set(pp) & set(pc)):
+        f0 = pp[name].get("roofline_fraction")
+        f1 = pc[name].get("roofline_fraction")
+        if isinstance(f0, (int, float)) and isinstance(f1, (int, float)) \
+                and f0 > 0:
+            drop = (f0 - f1) / f0
+        else:
+            m0 = pp[name].get("mean_s")
+            m1 = pc[name].get("mean_s")
+            if not (isinstance(m0, (int, float))
+                    and isinstance(m1, (int, float)) and m0 > 0):
+                continue
+            drop = (m1 - m0) / m0      # slower mean ~ fallen fraction
+            f0 = f1 = None
+        if drop > 0.1 and (worst is None or drop > worst[1]):
+            worst = (name, drop, f0, f1,
+                     pp[name].get("mean_s"), pc[name].get("mean_s"))
+    if worst is not None:
+        name, _drop, f0, f1, m0, m1 = worst
+        if f0 is not None:
+            notes.append(f"program '{name}' achieved fraction fell "
+                         f"{f0:.4f} -> {f1:.4f}")
+        else:
+            notes.append(f"program '{name}' device time grew "
+                         f"{1000 * m0:.1f} -> {1000 * m1:.1f} ms")
+    b0, b1 = dp.get("ledger_bytes"), dc.get("ledger_bytes")
+    if isinstance(b0, (int, float)) and isinstance(b1, (int, float)) \
+            and b0 > 0 and b1 > b0 * 1.1:
+        notes.append(f"resident HBM grew {int(b0)} -> {int(b1)} bytes "
+                     f"(+{100 * (b1 - b0) / b0:.0f}%)")
+    return "; ".join(notes)
+
+
 def attribute_regression(prev: Dict[str, Any],
                          cur: Dict[str, Any]) -> str:
     """Name the stage whose share of per-pod latency grew most between
     two rounds of one case — the SLO layer's stage_shares when both
-    carry it, the host/device split otherwise.  A pipeline-depth change
-    between the rounds is named first: a depth-driven delta is a config
-    delta, not a stage regression."""
+    carry it, the host/device split otherwise — plus the device-side
+    attribution (device_attribution) when both rounds carry a devstats
+    ``device`` block.  A pipeline-depth change between the rounds is
+    named first: a depth-driven delta is a config delta, not a stage
+    regression."""
     note = ""
     pd0, pd1 = prev.get("pipeline_depth"), cur.get("pipeline_depth")
     if (isinstance(pd0, (int, float)) and isinstance(pd1, (int, float))
             and pd0 != pd1):
         note = f"pipeline_depth changed {int(pd0)} -> {int(pd1)}; "
+    dev = device_attribution(prev, cur)
+    dev = ("; " + dev) if dev else ""
     ps = (prev.get("latency") or {}).get("stage_shares") or {}
     cs = (cur.get("latency") or {}).get("stage_shares") or {}
     if ps and cs:
@@ -166,14 +218,14 @@ def attribute_regression(prev: Dict[str, Any],
             return note + (f"stage '{stage}' share grew "
                            f"{ps.get(stage, 0.0):.2f} -> "
                            f"{cs.get(stage, 0.0):.2f}"
-                           f" (+{deltas[stage]:.2f})")
-        return note + "no stage share grew (uniform slowdown)"
+                           f" (+{deltas[stage]:.2f})") + dev
+        return note + "no stage share grew (uniform slowdown)" + dev
     hp, hc = prev.get("host_share"), cur.get("host_share")
     if isinstance(hp, (int, float)) and isinstance(hc, (int, float)):
         side = "host" if hc > hp else "device"
         return note + (f"no latency block on both sides; host_share "
-                       f"{hp:.2f} -> {hc:.2f} ({side} side grew)")
-    return note + "no latency/host_share data to attribute"
+                       f"{hp:.2f} -> {hc:.2f} ({side} side grew)") + dev
+    return note + "no latency/host_share data to attribute" + dev
 
 
 def build_trend(rounds: List[Dict[str, Any]],

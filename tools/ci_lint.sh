@@ -25,9 +25,12 @@ python -m tools.kubelint kubetpu/ --json
 # readback or sleep may ever run under the ring lock.  The durable cycle
 # journal (utils/journal.py) joins it: its file-index/counter state is
 # guarded-by annotated and record I/O runs outside the lock
+# devstats (utils/devstats.py) joins it: per-program timing + ledger
+# state is guarded-by annotated, and every record seam does its shape
+# walks / byte sums OUTSIDE the lock
 python -m tools.kubelint kubetpu/utils/trace.py kubetpu/utils/decisions.py \
 	kubetpu/utils/chaos.py kubetpu/utils/slo.py kubetpu/pipeline.py \
-	kubetpu/utils/journal.py \
+	kubetpu/utils/journal.py kubetpu/utils/devstats.py \
 	--rules concurrency --json
 # explicit delta-family pass over the serving loop: the cycle path must
 # stay scatter-only (full-retensorize-in-loop), independent of any
@@ -94,6 +97,14 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
 # zero divergence).
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
 	tests/test_replay.py -q -m 'not slow' -p no:cacheprovider
+# Device-side observability (kubetpu/utils/devstats.py): sampled
+# deep-timing fences measure per-program device time, the residency
+# ledger feeds the capacity planner (projection vs measured bytes must
+# agree within 10% at bench shapes), the roofline join resolves against
+# COMPILE_MANIFEST.json, and the house contract holds (disarmed zero-
+# lock poison test, armed-vs-disarmed placement parity golden).
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
+	tests/test_devstats.py -q -m 'not slow' -p no:cacheprovider
 # Bench-trend CI check (tools/benchtrend.py, pure JSON, no jax): the
 # committed BENCH_r*/MULTICHIP_r* trajectory must stay schema-compatible
 # with the trend tooling, and the newest parseable round must not

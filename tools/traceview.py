@@ -201,6 +201,34 @@ def journal_summary(doc) -> str:
     return "journal: " + ", ".join(parts)
 
 
+def device_summary(doc) -> str:
+    """One-line device observability digest under the stage table:
+    measured per-program device time (mean per fenced dispatch, sample
+    count) with the roofline fraction where the join resolved, plus the
+    residency-ledger total — read from the "device" block the pipeline
+    doc carries when KUBETPU_DEVSTATS was armed for the run
+    (kubetpu/utils/devstats.py; live twin at /debug/devicez)."""
+    d = doc.get("device")
+    if not isinstance(d, dict):
+        return ""
+    parts = []
+    for name, p in sorted((d.get("programs") or {}).items()):
+        if not p.get("count"):
+            continue
+        seg = (f"{name} {1000 * p.get('mean_s', 0.0):.1f}ms "
+               f"x{p['count']}")
+        frac = p.get("roofline_fraction")
+        if isinstance(frac, (int, float)):
+            seg += f" ({100 * frac:.1f}% of roofline)"
+        parts.append(seg)
+    lb = d.get("ledger_bytes")
+    if isinstance(lb, (int, float)) and lb > 0:
+        parts.append(f"HBM resident {lb / 1048576.0:.1f} MiB")
+    if not parts:
+        return ""
+    return "device: " + " | ".join(parts)
+
+
 def pipeline_summary(doc) -> str:
     """One-line depth-k pipeline digest under the stage table: the
     configured depth plus the ring-slot occupancy histogram (slot ->
@@ -280,6 +308,9 @@ def main(argv=None) -> int:
     pipe = pipeline_summary(doc)
     if pipe:
         print(pipe)
+    dev = device_summary(doc)
+    if dev:
+        print(dev)
     slo = slo_summary(doc)
     if slo:
         print(slo)
