@@ -4,8 +4,8 @@
 
 .PHONY: help lint lock-graph test sanitize-test race-test flight-test \
 	delta-test census census-test aot aot-test pallas-test chaos-test \
-	slo-test pipeline-test journal-test replay-test devstats-test trend \
-	trace bench
+	slo-test pipeline-test journal-test replay-test devstats-test \
+	mesh-test trend trace bench
 
 help:
 	@echo "kubetpu targets:"
@@ -78,6 +78,12 @@ help:
 	@echo "                      ledger + capacity-planner 10% sanity gate,"
 	@echo "                      /debug/devicez round trip, disarmed poison,"
 	@echo "                      armed-vs-disarmed placement parity"
+	@echo "  make mesh-test      pod-axis mesh scale-out suite (parallel/"
+	@echo "                      shardmap.py): (2,4)/(4,2)/(1,8) sharded-vs-"
+	@echo "                      unsharded bit-identity through the shard_map"
+	@echo "                      auction/scan (tiled + replicated surfaces,"
+	@echo "                      windowed rounds, serving path incl. the"
+	@echo "                      double-buffered batch upload)"
 	@echo "  make trend          per-case bench trend table over the committed"
 	@echo "                      BENCH_r*.json trajectory with per-stage"
 	@echo "                      regression attribution (tools/benchtrend.py)"
@@ -151,6 +157,13 @@ aot-test:
 pallas-test:
 	JAX_PLATFORMS=cpu python -m pytest \
 		tests/test_pallas_gang.py -q -m 'not slow' -p no:cacheprovider
+
+# pod-axis mesh scale-out (kubetpu/parallel/shardmap.py): the explicit
+# shard_map auction/scan vs the single-device oracle on the 8-virtual-CPU
+# mesh — the previously env-gated (2,4)/(4,2) shapes, ungated
+mesh-test:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_mesh.py -q -m 'not slow' -p no:cacheprovider
 
 # chaos harness (kubetpu/utils/chaos.py): every named injection point's
 # seeded recovery-invariant scenario — no lost pods, no double binds,

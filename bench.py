@@ -287,8 +287,11 @@ def run_mode(mode, n_nodes, n_pods, existing_per_node, repeats,
             "host_share": host_share(sched.device_wait_s, dt),
             # the executor depth this case drained at (1 = synchronous;
             # tools/benchtrend.py names depth changes when attributing
-            # cross-round deltas)
+            # cross-round deltas) — and the mesh shape (None = single
+            # device), named FIRST by the trend attribution: a
+            # mesh_shape change is a config delta, not a regression
             "pipeline_depth": pipeline_depth if pipeline else 1,
+            "mesh_shape": list(mesh_shape) if mesh_shape else None,
             # incremental tensorization (state/delta.py): rows the scatter
             # path updated per delta cycle + how often the blessed full
             # rebuild ran (last attempt's drain)
@@ -517,6 +520,14 @@ def northstar_gate(detail, path="NORTHSTAR.json"):
             "pipeline_depth: depth-k placements diverged from the "
             "depth-1 synchronous drain (bit-identity contract, "
             "kubetpu/pipeline.py)")
+    # ...and for the mesh: sharded placements diverging from the
+    # unsharded drain is a correctness failure (the mesh is a
+    # performance knob, never a semantics knob — parallel/shardmap.py)
+    if detail.get("multichip_scale", {}).get("placements_match") is False:
+        failures.append(
+            "multichip_scale: sharded placements diverged from the "
+            "unsharded drain (bit-identity contract, "
+            "kubetpu/parallel/shardmap.py)")
     # ...and for the journal replay rig: a journaled drain must replay
     # to byte-identical placements (utils/journal.py + tools/kubereplay
     # — the same oracle discipline), and a pipelineDepth counterfactual
@@ -1295,6 +1306,147 @@ def backend_compare_case(n_nodes=512, n_pods=2048, existing_per_node=2,
             "placements_match": bool(p_lax) and p_lax == p_pal}
 
 
+def multichip_scale_case(mesh_shape, n_nodes=512, n_pods=2048,
+                         existing_per_node=1, batch_cap=512):
+    """Pod-axis mesh scale-out (ROADMAP item 1): the SAME deterministic
+    north-star-SHAPED world — term-free pending pods, the tiled
+    shard_map auction's supported surface, drained in chained pipelined
+    cycles — run once unsharded and once on the virtual-CPU mesh.
+    Placements must be BIT-IDENTICAL (under BENCH_GATE a mismatch fails
+    the run like warm_restart's, no recorded floor needed: the mesh is a
+    performance knob, never a semantics knob).  On CPU the mesh seconds
+    carry no perf claim (8 virtual devices share the host); the JSON
+    records what a TPU run gates on — pod_e2e_p99_s, the per-shard
+    devstats device block + HBM split, and whether the double-buffered
+    batch upload actually overlapped the previous wave's device window
+    (flight-recorder span intersection)."""
+    import jax
+
+    from kubetpu.apis.config import (KubeSchedulerConfiguration,
+                                     KubeSchedulerProfile)
+    from kubetpu.client.store import ClusterStore
+    from kubetpu.harness import hollow
+    from kubetpu.scheduler import Scheduler
+    from kubetpu.utils import devstats as udevstats
+    from kubetpu.utils import trace as utrace
+
+    def run(shape):
+        dev = _devstats()
+        if dev is not None:
+            dev.clear()
+        slo_trk = _slo_tracker()
+        if slo_trk is not None:
+            slo_trk.clear()
+        store = ClusterStore()
+        for i, n in enumerate(hollow.make_nodes(n_nodes, zones=8)):
+            store.add(n)
+            for p in hollow.make_pods(existing_per_node, prefix=f"ex-{i}-",
+                                      group_labels=16):
+                p.spec.node_name = n.name
+                store.add(p)
+        # group_labels=0: term-free pending pods — needs_topo routes
+        # intra_batch_topology=False, so the mesh run takes the TILED
+        # gather-free shard_map auction (parallel/shardmap.py)
+        pending = hollow.make_pods(n_pods, prefix="pend-", group_labels=0)
+        cfg = KubeSchedulerConfiguration(
+            profiles=[KubeSchedulerProfile()],
+            batch_size=min(n_pods, batch_cap), mode="gang",
+            mesh_shape=shape, chain_cycles=True, pipeline_cycles=True,
+            pipeline_depth=2)
+        sched = Scheduler(store, config=cfg, async_binding=False)
+        for p in pending:
+            store.add(p)
+        placements = {}
+        cycle_times = []
+        rounds = []
+        t0 = time.time()
+        while True:
+            tc = time.time()
+            out = sched.schedule_pending(timeout=0.2)
+            if not out:
+                break
+            cycle_times.append(time.time() - tc)
+            rounds.append(sched.last_gang_rounds)
+            for o in out:
+                placements[o.pod.metadata.name] = o.node
+        dt = time.time() - t0
+        stats = {
+            "mesh_shape": list(shape) if shape else None,
+            "e2e_s": round(dt, 3),
+            "cycles": len(cycle_times),
+            "cycle_p50_s": round(_percentile(cycle_times, 0.5), 3),
+            "cycle_p99_s": round(_percentile(cycle_times, 0.99), 3),
+            "pods_per_sec": round(len(placements) / max(dt, 1e-9), 1),
+            "placed": sum(1 for v in placements.values() if v),
+            "auction_rounds_hist": _rounds_hist(rounds),
+            "journal_armed": _journal_armed(),
+        }
+        latency = _latency_block(slo_trk)
+        if latency is not None:
+            stats["latency"] = latency
+        if dev is not None:
+            # the per-shard device block: measured program seconds +
+            # the residency ledger split across the mesh (the ledger
+            # registers GLOBAL bytes; each shard holds 1/shards of every
+            # node/pod-axis table — exactly devstats.project's model)
+            stats["device"] = dev.summary()
+            if shape:
+                shards = int(shape[0]) * int(shape[1])
+                ledger = dev.ledger()
+                total = int(ledger.get("total_bytes", 0))
+                stats["per_shard"] = {
+                    "shards": shards,
+                    "hbm_bytes_per_shard": int(total // max(shards, 1)),
+                    "northstar_hbm_projection": udevstats.project(
+                        ledger, 10000, 100000, shards=shards,
+                        groups=("delta-resident", "chain")),
+                }
+        if shape:
+            # double-buffer visibility: a "batch-upload" span (issued in
+            # prepare, parallel/mesh-bound device_put) counts as
+            # OVERLAPPED when it starts inside another cycle's
+            # dispatch->readback window — the wave whose auction the
+            # transfer rode behind
+            rec = utrace.flight_recorder()
+            if rec is not None:
+                doc = rec.to_pipeline_doc(workload="multichip_scale")
+                spans = doc.get("spans", [])
+                windows = {}
+                for s in spans:
+                    if s["stage"] == "dispatch":
+                        w = windows.setdefault(s["cycle"], [None, None])
+                        w[0] = s["start_s"]
+                    elif s["stage"] == "packed-readback":
+                        w = windows.setdefault(s["cycle"], [None, None])
+                        w[1] = s["end_s"]
+                ups = [s for s in spans if s["stage"] == "batch-upload"]
+                overlapped = sum(
+                    1 for s in ups
+                    if any(w[0] is not None and w[1] is not None
+                           and w[0] <= s["start_s"] <= w[1]
+                           for c, w in windows.items()
+                           if c != s["cycle"]))
+                stats["batch_upload"] = {
+                    "spans": len(ups),
+                    "overlapped_prev_device_window": overlapped,
+                    "double_buffered": True,
+                }
+        sched.close()
+        return placements, stats
+
+    p_ref, s_ref = run(None)
+    p_mesh, s_mesh = run(tuple(mesh_shape))
+    return {"nodes": n_nodes, "pods": n_pods,
+            "mesh_shape": list(mesh_shape),
+            "backend": jax.default_backend(),
+            "unsharded": s_ref, "sharded": s_mesh,
+            "pod_e2e_p99_s": (s_mesh.get("latency") or {}).get(
+                "pod_e2e_p99_s"),
+            "northstar_hbm_projection": (s_mesh.get("per_shard") or {}).get(
+                "northstar_hbm_projection"),
+            "placements_match": bool(p_ref) and p_ref == p_mesh}
+
+
 def main() -> None:
     n_nodes = int(os.environ.get("BENCH_NODES", "1000"))
     n_pods = int(os.environ.get("BENCH_PODS", "4096"))
@@ -1405,6 +1557,16 @@ def main() -> None:
     if trace_doc is not None:
         atomic_write_json("PIPELINE_TRACE.json", trace_doc)
         atomic_write_json("PIPELINE_TRACE.perfetto.json", chrome_doc)
+
+    if (mesh_shape is not None
+            and os.environ.get("BENCH_MULTICHIP_SCALE", "1") == "1"):
+        # the pod-axis mesh case rides ONLY the MULTICHIP runs (the
+        # virtual mesh exists there); placements_match gates like
+        # warm_restart's under BENCH_GATE
+        try:
+            detail["multichip_scale"] = multichip_scale_case(mesh_shape)
+        except Exception as e:  # pragma: no cover - depends on device state
+            detail["multichip_scale"] = {"error": repr(e)}
 
     if os.environ.get("BENCH_CHAIN_DRAIN", "1") == "1" and mesh_shape is None:
         try:

@@ -156,6 +156,58 @@ def _seg_prefix(e_sorted: jnp.ndarray, is_start: jnp.ndarray) -> jnp.ndarray:
     return excl - _segment_base(excl, is_start)
 
 
+def admission_mask(prop, active, req_b, ports_hot_b, ports_asnode_b,
+                   allocatable, req_carry, use_ports: bool,
+                   n_nodes: int) -> jnp.ndarray:
+    """The segmented-reduce admission verdict over one round's proposals:
+    sort by proposed node (stable keeps pod order — the batch is popped in
+    priority order, so row index IS the reference's serial order), then
+    admit each proposer iff its request fits the node's free capacity
+    minus EARLIER proposers' requests (a superset of earlier admitted)
+    and its probed hostPorts miss every earlier proposer's registered
+    set.  Shared verbatim by the lax round (_round_tail) and the
+    shard_map tiled round (parallel/shardmap.py) — ONE source of truth
+    keeps the two paths bit-identical by construction.  prop must use
+    n_nodes as the no-op segment for inactive pods."""
+    order = jnp.argsort(prop, stable=True)
+    snode = prop[order]
+    sactive = active[order]
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), bool), snode[1:] != snode[:-1]])
+    sreq = req_b[order] * _f(sactive)[:, None]
+    prefix_excl = _seg_prefix(sreq, is_start)
+    node_safe = jnp.clip(snode, 0, n_nodes - 1)
+    free = allocatable[node_safe] - req_carry[node_safe]
+    cap_ok = K.fit_rows(req_b[order], free - prefix_excl)
+    if use_ports:
+        sreg = ports_asnode_b[order] * _f(sactive)[:, None]
+        earlier_ports = _seg_prefix(sreg, is_start)
+        conflict = jnp.sum(ports_hot_b[order] * earlier_ports,
+                           axis=1) > 0.5
+        cap_ok = cap_ok & ~conflict
+    admit_sorted = cap_ok & sactive & (snode < n_nodes)
+    return jnp.zeros(prop.shape, bool).at[order].set(admit_sorted)
+
+
+def admission_sums(admit, prop, req_b, nonzero_b, ports_asnode_b,
+                   use_ports: bool, n_nodes: int):
+    """Commit-side segment sums of one round's admitted placements:
+    (add_req [N, R], add_nz [N, 2], add_ports [N, P] | None).  Shared by
+    _round_tail and the shard_map tiled round."""
+    seg = jnp.where(admit, prop, n_nodes)
+    add_req = jax.ops.segment_sum(
+        req_b * _f(admit)[:, None], seg, num_segments=n_nodes + 1)[:n_nodes]
+    add_nz = jax.ops.segment_sum(
+        nonzero_b * _f(admit)[:, None], seg,
+        num_segments=n_nodes + 1)[:n_nodes]
+    add_ports = None
+    if use_ports:
+        add_ports = jax.ops.segment_max(
+            ports_asnode_b * _f(admit)[:, None], seg,
+            num_segments=n_nodes + 1)[:n_nodes]
+    return add_req, add_nz, add_ports
+
+
 def _key_terms_mask(terms, k: int) -> jnp.ndarray:
     """[B, T] bool — valid required terms on topology key k."""
     return (terms.topo_key == k) & terms.valid & terms.topo_known
@@ -349,6 +401,28 @@ def _schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
                    residual_window: int = 512,
                    score_bias: Optional[jnp.ndarray] = None,
                    kernel_backend: str = "lax") -> GangResult:
+    return _gang_program(cluster, batch, cfg, rng, host_ok=host_ok,
+                         max_rounds=max_rounds,
+                         intra_batch_topology=intra_batch_topology,
+                         tie_index=tie_index,
+                         residual_window=residual_window,
+                         score_bias=score_bias,
+                         kernel_backend=kernel_backend)
+
+
+def _gang_program(cluster, batch, cfg: ProgramConfig, rng,
+                  host_ok: Optional[jnp.ndarray] = None,
+                  max_rounds: Optional[int] = None,
+                  intra_batch_topology: bool = True,
+                  tie_index: Optional[jnp.ndarray] = None,
+                  residual_window: int = 512,
+                  score_bias: Optional[jnp.ndarray] = None,
+                  kernel_backend: str = "lax") -> GangResult:
+    """The auction program body, jit-free: `_schedule_gang` above is its
+    single-device jit root, and the shard_map mesh path
+    (parallel/shardmap.py) traces the SAME body per device for its
+    replicated topology surface — bit-identity across paths by
+    construction, not by parallel maintenance."""
     from .batch import densify_for
     batch = densify_for(cluster, batch)
     B = batch.req.shape[0]
@@ -721,52 +795,22 @@ def _schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
         # ---- admission: sort by proposed node (stable keeps pod order;
         # rows are ascending original indices, so sub-round order == the
         # full round's order restricted to these pods) ----
-        order = jnp.argsort(prop, stable=True)
-        snode = prop[order]
-        sactive = active[order]
-        is_start = jnp.concatenate(
-            [jnp.ones((1,), bool), snode[1:] != snode[:-1]])
-
-        sreq = sbatch.req[order] * _f(sactive)[:, None]         # [W, R]
-        csum = jnp.cumsum(sreq, axis=0)
-        excl = csum - sreq
-        prefix_excl = excl - _segment_base(excl, is_start)      # earlier
-        node_safe = jnp.clip(snode, 0, N - 1)                   # proposers'
-        free = (cluster.allocatable[node_safe]                  # usage
-                - c["req"][node_safe])
-        cap_ok = K.fit_rows(sbatch.req[order], free - prefix_excl)
-
-        if use_ports:
-            sreg = sbatch.ports_asnode_hot[order] * _f(sactive)[:, None]
-            pcs = jnp.cumsum(sreg, axis=0)
-            pexcl = pcs - sreg
-            earlier_ports = pexcl - _segment_base(pexcl, is_start)
-            conflict = jnp.sum(sbatch.ports_hot[order] * earlier_ports,
-                               axis=1) > 0.5
-            cap_ok = cap_ok & ~conflict
-
-        W = rows.shape[0]
-        admit_sorted = cap_ok & sactive & (snode < N)
-        admit = jnp.zeros((W,), bool).at[order].set(admit_sorted)
+        admit = admission_mask(prop, active, sbatch.req, sbatch.ports_hot,
+                               sbatch.ports_asnode_hot, cluster.allocatable,
+                               c["req"], use_ports, N)
         if intra:
             # intra-round topology serialization (conservative; deferred
             # pods re-check against exact committed counts next round)
             admit = admit & ~topology_deferral(sb, admit, prop, boot_live)
 
         # ---- commit ----
-        seg = jnp.where(admit, prop, N)
-        add_req = jax.ops.segment_sum(
-            sbatch.req * _f(admit)[:, None], seg, num_segments=N + 1)[:N]
-        add_nz = jax.ops.segment_sum(
-            sbatch.nonzero_req * _f(admit)[:, None], seg,
-            num_segments=N + 1)[:N]
+        add_req, add_nz, add_ports = admission_sums(
+            admit, prop, sbatch.req, sbatch.nonzero_req,
+            sbatch.ports_asnode_hot, use_ports, N)
         new = dict(c)
         new["req"] = c["req"] + add_req
         new["nz"] = c["nz"] + add_nz
         if use_ports:
-            add_ports = jax.ops.segment_max(
-                sbatch.ports_asnode_hot * _f(admit)[:, None], seg,
-                num_segments=N + 1)[:N]
             new["ports_used"] = jnp.maximum(c["ports_used"], add_ports)
         new["assigned"] = c["assigned"].at[rows].set(
             jnp.where(admit, prop, jnp.take(c["assigned"], rsafe)),
@@ -805,7 +849,7 @@ def _schedule_gang(cluster, batch, cfg: ProgramConfig, rng,
     fsb = full_sub()
     if use_pallas:
         fsb["bundle"] = bundle
-    use_window = bool(residual_window) and residual_window < B
+    use_window = bool(residual_window) and residual_window < B  # kubelint: ignore[host-sync/cast] trace-time constant: residual_window is a static int (jit static_argnames on _schedule_gang)
 
     if not use_window:
         def cond(c):

@@ -113,6 +113,22 @@ def _schedule_sequential(cluster, batch, cfg: ProgramConfig, rng,
                          hard_pod_affinity_weight: float = 1.0,
                          host_ok=None, start_index=0,
                          score_bias=None) -> SeqResult:
+    return _sequential_program(
+        cluster, batch, cfg, rng,
+        hard_pod_affinity_weight=hard_pod_affinity_weight,
+        host_ok=host_ok, start_index=start_index, score_bias=score_bias)
+
+
+def _sequential_program(cluster, batch, cfg: ProgramConfig, rng,
+                        hard_pod_affinity_weight: float = 1.0,
+                        host_ok=None, start_index=0,
+                        score_bias=None) -> SeqResult:
+    """The scan program body, jit-free: `_schedule_sequential` above is
+    its single-device jit root, and the shard_map mesh path
+    (parallel/shardmap.py) traces the SAME body per device — the pod-axis
+    mesh correctness fix replicates this serial scan explicitly instead
+    of letting the legacy SPMD partitioner mis-lower its cross-shard
+    index selection."""
     from .batch import densify_for
     batch = densify_for(cluster, batch)
     B = batch.req.shape[0]

@@ -132,11 +132,16 @@ def shard_cluster(cluster: ClusterTensors, mesh: Mesh,
 def shard_batch(batch, mesh: Mesh):
     """Shard every PodBatch leaf on dim 0 over the "pods" axis.  All batch
     leaves lead with B or a flattened B*T axis, so dim-0 sharding is the
-    data-parallel split of the pending-pod batch."""
+    data-parallel split of the pending-pod batch.  Leaves that are
+    already jax Arrays pass through without a host round-trip (the
+    double-buffered upload path hands an ALREADY-SHARDED batch back in
+    at dispatch — np.asarray here would pull every leaf through the
+    tunnel just to re-upload it)."""
     n = mesh.shape[AXIS_PODS]
 
     def put(x):
-        x = np.asarray(x)
+        if not isinstance(x, jax.Array):
+            x = np.asarray(x)
         if x.ndim >= 1 and x.shape[0] % n == 0:
             return _put(x, NamedSharding(mesh, P(AXIS_PODS)))
         return _put(x, NamedSharding(mesh, P()))
@@ -149,17 +154,29 @@ def replicate(tree, mesh: Mesh):
 
 
 def sharded_apply_cluster_delta(cluster, delta, mesh: Mesh,
-                                donate: bool = True):
+                                donate: bool = True,
+                                partitioner: Optional[str] = None):
     """Apply a ClusterDelta to the SHARDED resident cluster, shard-locally:
-    the [D]-indexed update tables are tiny and ride replicated, and the
-    SPMD partitioner lowers each ``x.at[rows].set`` into per-shard
-    scatters — no shard ever re-materializes (or re-uploads) the full
-    [N, R] / [P, L] tensors.  The cluster keeps its committed shardings,
-    so the next dispatch's shard_cluster is a pass-through."""
-    from ..models import programs
-    delta = replicate(jax.tree.map(np.asarray, delta), mesh)
-    with ambient_mesh(mesh):
-        return programs.apply_cluster_delta(cluster, delta, donate=donate)
+    the [D]-indexed update tables are tiny and ride replicated, and each
+    shard scatters only its locally-owned rows — no shard ever
+    re-materializes (or re-uploads) the full [N, R] / [P, L] tensors.
+    The cluster keeps its committed shardings, so the next dispatch's
+    shard_cluster is a pass-through.
+
+    Default lowering is the EXPLICIT shard_map scatter
+    (parallel/shardmap.py apply_cluster_delta_mesh — required for
+    pod-axis sharded residents, where the legacy SPMD partitioner
+    mis-lowers cross-shard index selection); ``partitioner="gspmd"``
+    keeps the old ambient-mesh lowering for comparison/regression use."""
+    if (partitioner or "shard_map") == "gspmd":
+        from ..models import programs
+        delta = replicate(jax.tree.map(np.asarray, delta), mesh)
+        with ambient_mesh(mesh):
+            return programs.apply_cluster_delta(cluster, delta,
+                                                donate=donate)
+    from . import shardmap
+    return shardmap.apply_cluster_delta_mesh(cluster, delta, mesh,
+                                             donate=donate)
 
 
 def sharded_schedule_batch(cluster, batch, cfg: programs.ProgramConfig, rng,
@@ -200,21 +217,33 @@ def sharded_schedule_gang(cluster, batch, cfg: programs.ProgramConfig, rng,
                           mesh: Mesh, shard_existing_pods: bool = True,
                           max_rounds: Optional[int] = None,
                           host_ok=None, intra_batch_topology: bool = True,
-                          score_bias=None):
-    """Gang auction over the mesh.  The [B, N] filter/score work shards over
-    both axes; the admission sort + segmented prefix-sums are [B]-sized (a
-    few MB even at 100k pods), which XLA gathers as needed — the per-round
-    collectives replace the serial loop's cross-pod carries."""
-    cluster = shard_cluster(cluster, mesh, shard_existing_pods)
-    batch = shard_batch(batch, mesh)
-    rng = _put(rng, NamedSharding(mesh, P()))
-    with ambient_mesh(mesh):
-        return gang.schedule_gang(cluster, batch, cfg, rng,
-                                  host_ok=_shard_host_ok(host_ok, mesh),
-                                  max_rounds=max_rounds,
-                                  intra_batch_topology=intra_batch_topology,
-                                  score_bias=_shard_host_ok(score_bias,
-                                                            mesh))
+                          score_bias=None,
+                          partitioner: Optional[str] = None):
+    """Gang auction over the mesh.  Default lowering is the EXPLICIT
+    shard_map auction (parallel/shardmap.py): the [B, N] filter/score
+    work shards over both axes, per-pod winners resolve via node-axis
+    collectives + a pods-axis all_gather, and admission runs replicated
+    — correct on pod-axis (2, 4)/(4, 2) meshes where the legacy SPMD
+    partitioner mis-lowers the loop machinery (PR 6 skip markers).
+    ``partitioner="gspmd"`` keeps the old derive-everything lowering,
+    exact on node-axis (1, N) meshes only."""
+    if (partitioner or "shard_map") == "gspmd":
+        cluster = shard_cluster(cluster, mesh, shard_existing_pods)
+        batch = shard_batch(batch, mesh)
+        rng = _put(rng, NamedSharding(mesh, P()))
+        with ambient_mesh(mesh):
+            return gang.schedule_gang(
+                cluster, batch, cfg, rng,
+                host_ok=_shard_host_ok(host_ok, mesh),
+                max_rounds=max_rounds,
+                intra_batch_topology=intra_batch_topology,
+                score_bias=_shard_host_ok(score_bias, mesh))
+    from . import shardmap
+    return shardmap.schedule_gang_mesh(
+        cluster, batch, cfg, rng, mesh,
+        shard_existing_pods=shard_existing_pods, max_rounds=max_rounds,
+        host_ok=host_ok, intra_batch_topology=intra_batch_topology,
+        score_bias=score_bias)
 
 
 def sharded_schedule_sequential(cluster, batch, cfg: programs.ProgramConfig,
@@ -222,17 +251,29 @@ def sharded_schedule_sequential(cluster, batch, cfg: programs.ProgramConfig,
                                 shard_existing_pods: bool = True,
                                 hard_pod_affinity_weight: float = 1.0,
                                 host_ok=None, start_index=0,
-                                score_bias=None):
-    """Sequential-replay scan over the mesh: the scan axis (pods, in order)
-    is serial by construction; each step's per-node work shards over
-    "nodes" and the precomputed O(B×P×N) matmuls shard over both axes."""
-    cluster = shard_cluster(cluster, mesh, shard_existing_pods)
-    batch = shard_batch(batch, mesh)
-    rng = _put(rng, NamedSharding(mesh, P()))
-    with ambient_mesh(mesh):
-        return sequential.schedule_sequential(
-            cluster, batch, cfg, rng,
-            hard_pod_affinity_weight=hard_pod_affinity_weight,
-            host_ok=_shard_host_ok(host_ok, mesh),
-            start_index=start_index,
-            score_bias=_shard_host_ok(score_bias, mesh))
+                                score_bias=None,
+                                partitioner: Optional[str] = None):
+    """Sequential-replay scan over the mesh.  Default lowering is the
+    explicit shard_map program (parallel/shardmap.py): the scan axis
+    (pods, in order) is serial by construction, so the per-device body
+    replicates the exact single-device scan — the correctness fix for
+    the legacy partitioner's cross-shard index selection on pod-axis
+    meshes.  ``partitioner="gspmd"`` keeps the old lowering (exact on
+    node-axis (1, N) meshes only)."""
+    if (partitioner or "shard_map") == "gspmd":
+        cluster = shard_cluster(cluster, mesh, shard_existing_pods)
+        batch = shard_batch(batch, mesh)
+        rng = _put(rng, NamedSharding(mesh, P()))
+        with ambient_mesh(mesh):
+            return sequential.schedule_sequential(
+                cluster, batch, cfg, rng,
+                hard_pod_affinity_weight=hard_pod_affinity_weight,
+                host_ok=_shard_host_ok(host_ok, mesh),
+                start_index=start_index,
+                score_bias=_shard_host_ok(score_bias, mesh))
+    from . import shardmap
+    return shardmap.schedule_sequential_mesh(
+        cluster, batch, cfg, rng, mesh,
+        shard_existing_pods=shard_existing_pods,
+        hard_pod_affinity_weight=hard_pod_affinity_weight,
+        host_ok=host_ok, start_index=start_index, score_bias=score_bias)

@@ -141,6 +141,13 @@ class PreparedCycle:
     # the wrong one
     devstats_fenced: bool = False
     devstats_fence_s: float = 0.0
+    # DOUBLE-BUFFERED batch transfer (mesh serving): the sharded device
+    # copy of `batch`, upload STARTED at prepare time so the host->device
+    # transfer of wave k+1 overlaps wave k's auction on the device
+    # (device_put is async; the tunnel serves the transfer behind the
+    # queued auction program).  _dispatch_group consumes it instead of
+    # re-uploading; None on single-chip profiles
+    batch_dev: object = None
 
 
 class Scheduler:
@@ -273,6 +280,15 @@ class Scheduler:
         # rebuild is demoted to its anti-entropy resync (serving thread
         # only, like _audit_cache)
         self._delta: Dict[str, DeltaTensorizer] = {}
+        # prepared-but-not-yet-dispatched cycles whose double-buffered
+        # batch upload is in flight (mesh serving): their dispatch will
+        # still READ the resident cluster, so the delta scatter's
+        # donation is withheld while any of them exists —
+        # DeltaTensorizer.safe_to_donate stays the single gate, this
+        # list just joins the in-flight ring in feeding it.  Serving
+        # thread only (appended in _prepare_group, removed at dispatch
+        # or discard)
+        self._undispatched: List[PreparedCycle] = []
         # delta telemetry for bench/perf: updated-row counts of recent
         # delta cycles (bounded ring) + monotonic tallies so windowed
         # readers survive ring eviction (serving thread only)
@@ -450,7 +466,14 @@ class Scheduler:
             self._chain_ledger_key = None
 
     def _chain_enabled(self, fwk) -> bool:
-        return (self.config.mode == "gang" and self._mesh is None
+        # mesh profiles chain too (PR 14): materialize_assigned is a
+        # concat/pad/scatter program — the kernel class the partitioner
+        # lowers correctly at every mesh shape (unlike the auction loop,
+        # which needed the explicit shard_map rewrite) — and without the
+        # chain the depth-k executor serializes on mesh profiles, which
+        # would leave the double-buffered batch upload nothing to
+        # overlap with
+        return (self.config.mode == "gang"
                 and getattr(self.config, "chain_cycles", False))
 
     def _add_pod_to_cache(self, pod: api.Pod) -> None:
@@ -503,7 +526,7 @@ class Scheduler:
             # strictly serial semantics (scheduler.go:510 pops one pod)
             max_batch = 1
         if (self.config.pipeline_cycles and not self.extenders
-                and self.config.mode == "gang" and self._mesh is None
+                and self.config.mode == "gang"
                 and getattr(self.config, "chain_cycles", False)):
             # the depth-k pipelined executor (kubetpu/pipeline.py):
             # prepare(k+1) overlaps device(k) and commit/bind(k-1)
@@ -725,8 +748,14 @@ class Scheduler:
             # either.
             inflight = (uncommitted if uncommitted is not None
                         else self._pipeline.inflight_preps())
+            # the donation-withholding set: every dispatched-but-
+            # uncommitted ring cycle PLUS every prepared cycle whose
+            # double-buffered batch upload is still in flight (its
+            # dispatch hasn't consumed the resident yet) — one gate,
+            # fed from both sources
             donate = delta.safe_to_donate(
-                [p.cluster for p in inflight if p is not None])
+                [p.cluster for p in inflight if p is not None]
+                + [p.cluster for p in self._undispatched])
             # pending/nominated pods intern inside refresh (a compacting
             # resync re-interns them into its fresh table)
             cluster, dstats = delta.refresh(
@@ -789,6 +818,27 @@ class Scheduler:
         pb = PodBatchBuilder(builder.table)
         batch = self._jax.tree.map(np.asarray,
                                    pb.build(pinfos, spread_selectors=spread_sels))
+        batch_dev = None
+        if self._mesh is not None:
+            # DOUBLE-BUFFERED transfer: start the sharded upload of this
+            # wave's batch NOW — in the depth-k drain, prepare(k+1) runs
+            # while wave k's auction occupies the device, so the
+            # host->device transfer rides behind the running program
+            # (FIFO tunnel) instead of serializing in front of k+1's
+            # dispatch.  device_put is async; the span below measures
+            # issue time, and traceview shows it inside the prepare
+            # stage — i.e. UNDER the previous wave's device window
+            from .parallel import mesh as pmesh
+            t_up = utrace.wallclock()
+            batch_dev = pmesh.shard_batch(batch, self._mesh)
+            if trace.rec is not None:
+                nbytes = sum(np.asarray(x).nbytes
+                             for x in self._jax.tree.leaves(batch))
+                trace.rec.record_span("batch-upload", t_up,
+                                      utrace.wallclock(),
+                                      parent_id=trace.span_id,
+                                      bytes=int(nbytes),
+                                      double_buffered=True)
         B = batch.valid.shape[0]
         N = cluster.allocatable.shape[0]
         if trace.rec is not None:
@@ -950,7 +1000,13 @@ class Scheduler:
             cycle_ctx=cycle_ctx, needs_topo=needs_topo,
             used_chain=use_chain, chain_pod_uids=chain_pod_uids,
             score_bias=score_bias, host_reject=host_reject,
-            relevance=relevance, journal_input=journal_input)
+            relevance=relevance, journal_input=journal_input,
+            batch_dev=batch_dev)
+        if batch_dev is not None:
+            # until _dispatch_group consumes the upload, this cycle's
+            # dispatch still reads the resident cluster — withhold
+            # donation (see __init__._undispatched)
+            self._undispatched.append(prep)
         return prep, outcomes
 
     def _dispatch_group(self, prep: PreparedCycle, extra_uncommitted: int = 0):
@@ -966,6 +1022,15 @@ class Scheduler:
                                     prep.cfg)
         host_ok_dev, cycle_ctx = prep.host_ok_dev, prep.cycle_ctx
         n_nodes = len(prep.node_infos)
+        # the double-buffered upload is consumed by THIS dispatch; the
+        # cycle graduates to the ordinary in-flight donation set
+        # (identity filter: PreparedCycle holds arrays, == is undefined)
+        self._undispatched = [p for p in self._undispatched
+                              if p is not prep]
+        if prep.batch_dev is not None:
+            # consume the pre-uploaded sharded batch (shard_batch passes
+            # committed-sharding arrays through untouched)
+            batch = prep.batch_dev
         # deadline-guard anchor + chaos seam (utils/chaos.py "dispatch"):
         # an injected error models the device dying under the program; an
         # injected stall models a hung tunnel — both recovered by
@@ -1575,7 +1640,11 @@ class Scheduler:
         journal capture carried resident state (delta scatter or resync),
         that state is now applied on device but will never be journaled
         — flag the PROFILE's next journaled cycle to re-anchor.
-        Chain/noop captures carry no resident state and need nothing."""
+        Chain/noop captures carry no resident state and need nothing.
+        Also drops the cycle from the double-buffer donation-withholding
+        set — a discarded cycle's upload will never be consumed."""
+        self._undispatched = [p for p in self._undispatched
+                              if p is not prep]
         if prep.journal_input is not None \
                 and prep.journal_input[0] in ("delta", "resync"):
             self._journal_force_anchor.add(prep.fwk.profile_name)

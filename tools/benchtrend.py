@@ -90,6 +90,14 @@ def load_round(path: str) -> Dict[str, Any]:
                 "note": f"unreadable ({e.__class__.__name__})"}
     detail = _find_detail(doc)
     if detail is None:
+        if isinstance(doc, dict) and "n_devices" in doc and "rc" in doc:
+            # a MULTICHIP dryrun capture ({n_devices, rc, ok, tail}) —
+            # a pass/fail record, not a bench round; a MULTICHIP-only
+            # trajectory is a state, never an error
+            return {"round": name, "detail": None,
+                    "note": "multichip dryrun capture (ok=%s, %s "
+                            "devices) — no bench detail to trend"
+                            % (doc.get("ok"), doc.get("n_devices"))}
         return {"round": name, "detail": None,
                 "note": "no parseable detail document "
                         "(truncated capture or non-bench artifact)"}
@@ -198,14 +206,21 @@ def attribute_regression(prev: Dict[str, Any],
     two rounds of one case — the SLO layer's stage_shares when both
     carry it, the host/device split otherwise — plus the device-side
     attribution (device_attribution) when both rounds carry a devstats
-    ``device`` block.  A pipeline-depth change between the rounds is
-    named first: a depth-driven delta is a config delta, not a stage
-    regression."""
+    ``device`` block.  Config deltas are named FIRST — a mesh_shape or
+    pipeline-depth change between the rounds is a config delta, not a
+    stage regression — so "mesh_shape changed" leads the line before
+    any stage-share diff."""
     note = ""
+    ms0, ms1 = prev.get("mesh_shape"), cur.get("mesh_shape")
+    if ms0 != ms1 and (ms0 is not None or ms1 is not None):
+        def _ms(v):
+            return "x".join(str(x) for x in v) if isinstance(
+                v, (list, tuple)) else ("none" if v is None else str(v))
+        note = f"mesh_shape changed {_ms(ms0)} -> {_ms(ms1)}; "
     pd0, pd1 = prev.get("pipeline_depth"), cur.get("pipeline_depth")
     if (isinstance(pd0, (int, float)) and isinstance(pd1, (int, float))
             and pd0 != pd1):
-        note = f"pipeline_depth changed {int(pd0)} -> {int(pd1)}; "
+        note += f"pipeline_depth changed {int(pd0)} -> {int(pd1)}; "
     dev = device_attribution(prev, cur)
     dev = ("; " + dev) if dev else ""
     ps = (prev.get("latency") or {}).get("stage_shares") or {}

@@ -28,17 +28,24 @@ python -m tools.kubelint kubetpu/ --json
 # devstats (utils/devstats.py) joins it: per-program timing + ledger
 # state is guarded-by annotated, and every record seam does its shape
 # walks / byte sums OUTSIDE the lock
+# The shard_map mesh module (kubetpu/parallel/shardmap.py) joins it:
+# its trace-time Mesh registry is guarded-by annotated and read only at
+# trace time (never under a traced computation)
 python -m tools.kubelint kubetpu/utils/trace.py kubetpu/utils/decisions.py \
 	kubetpu/utils/chaos.py kubetpu/utils/slo.py kubetpu/pipeline.py \
 	kubetpu/utils/journal.py kubetpu/utils/devstats.py \
+	kubetpu/parallel/shardmap.py \
 	--rules concurrency --json
 # explicit delta-family pass over the serving loop: the cycle path must
 # stay scatter-only (full-retensorize-in-loop), independent of any
 # unrelated suppression elsewhere in the tree.  The pipelined executor
 # rides along — its drain is the cycle loop now.  journal.py rides too:
 # it reads the resident mirror at commit and must never re-tensorize
+# parallel/shardmap.py rides the delta pass too: the mesh dispatch
+# wrappers sit on the cycle path and must never re-tensorize or
+# re-device_put the resident cluster outside the blessed seams
 python -m tools.kubelint kubetpu/scheduler.py kubetpu/pipeline.py \
-	kubetpu/utils/journal.py \
+	kubetpu/utils/journal.py kubetpu/parallel/shardmap.py \
 	--rules delta --json
 # compile-surface census (tools/kubecensus): jaxpr-level abstract
 # interpretation of every jit root.  Fails on (a) any unsuppressed
@@ -65,6 +72,15 @@ python -m tools.kubeaot --check --json
 # pytest skip (the suite's module-level skipif), never a failure.
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
 	tests/test_pallas_gang.py -q -m 'not slow' -p no:cacheprovider
+# Pod-axis mesh scale-out (kubetpu/parallel/shardmap.py): the explicit
+# shard_map auction/scan vs the single-device oracle on the 8-virtual-CPU
+# mesh — sharded-vs-unsharded bit-identity at the previously env-gated
+# (2,4)/(4,2) shapes (tiled + replicated surfaces, windowed rounds, the
+# serving path with the double-buffered batch upload and the pre-sharded
+# delta scatter).  The legacy gspmd lowering keeps its documented
+# env-gated skip inside the suite.
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
+	tests/test_mesh.py -q -m 'not slow' -p no:cacheprovider
 # Chaos harness + self-healing runtime (utils/chaos.py): every named
 # injection point's seeded recovery scenario — serving thread alive, no
 # lost pods, no double binds, mirror/device fingerprint match after

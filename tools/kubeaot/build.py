@@ -62,12 +62,22 @@ def _fresh_compiles():
         jax.config.update("jax_compilation_cache_dir", prev)
 
 # the seamed serving programs (kubetpu/utils/aot.py dispatch seams in
-# models/gang.py, models/sequential.py, models/programs.py) — the only
-# jit roots a deserialized executable can ever be dispatched for.  Mesh
-# variants are excluded: the sharded family calls pmesh.sharded_* and
-# does not route through the seams.
+# models/gang.py, models/sequential.py, models/programs.py, and the
+# mesh twins in parallel/shardmap.py) — the only jit roots a
+# deserialized executable can ever be dispatched for.  Legacy gspmd
+# @mesh variants are excluded: that family calls jit under an ambient
+# mesh and does not route through the seams; the shard_map programs DO
+# (schedule_gang_mesh / schedule_sequential_mesh).  HONEST COVERAGE
+# NOTE: artifacts capture at the census (1, 1)-mesh rung, and the mesh
+# key is part of the signature — a (2, 4) fleet's dispatches sign
+# differently and fall back per key to the trace path, so today the
+# mesh rows pin the build-time sha oracle (lowering == manifest) and
+# make arming safe, NOT a production mesh warm start.  Deploy-shaped
+# mesh capture needs build_shape to run under the fleet's mesh config
+# on a same-topology build host — the ROADMAP item 1 residual.
 AOT_PROGRAMS = ("_schedule_gang", "_schedule_sequential",
-                "_materialize_assigned", "_explain_verdicts")
+                "_materialize_assigned", "_explain_verdicts",
+                "_shardmap_gang", "_shardmap_sequential")
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))

@@ -136,11 +136,16 @@ def trace_variant(entry: Entry, rung: Rung, mesh: bool = False) -> Variant:
     dyn_kw, static_kw = _split_kwargs(kwargs, entry.static_argnames)
     if mesh:
         args, dyn_kw = _mesh_place(entry, args, dyn_kw)
+    # keep committed NamedShardings either for the @mesh twin (inputs
+    # placed above) or for entries whose builders already commit them
+    # (the shard_map family) — stripping them would lower a module the
+    # serving path never dispatches
+    keep = mesh or entry.keep_sharding
     stat_idx = set(entry.static_argnums)
     abs_args = tuple(a if i in stat_idx
-                     else _abstract(a, keep_sharding=mesh)
+                     else _abstract(a, keep_sharding=keep)
                      for i, a in enumerate(args))
-    abs_dyn = _abstract(dyn_kw, keep_sharding=mesh)
+    abs_dyn = _abstract(dyn_kw, keep_sharding=keep)
     dyn_pos = [a for i, a in enumerate(abs_args) if i not in stat_idx]
     # Cold-cache lowering: jax dedups repeated sub-jaxprs (_where/_take/
     # clip helpers) into shared private funcs through trace caches that

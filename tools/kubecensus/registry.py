@@ -171,6 +171,12 @@ class Entry:
     build: Callable[[CensusWorld], tuple]
     tag: str = ""
     meshable: bool = False
+    # the builder's inputs are ALREADY committed to a mesh and the
+    # lowering must keep their NamedShardings (the shard_map family:
+    # serving dispatches these with committed-sharded residents, and the
+    # AOT capture's sha must equal the manifest's) — the per-entry twin
+    # of the meshable variants' keep_sharding flow
+    keep_sharding: bool = False
     donate_argnums: Tuple[int, ...] = ()
     # kwarg names / positional indices the jit treats as STATIC (mirrors
     # the decorator's static_argnames); every other arg is a traced input
@@ -329,6 +335,80 @@ def _schedule_gang_pallas_hostok(w):
     return (gang._schedule_gang, (w.cluster, w.batch, w.cfg, w.rng),
             {"host_ok": w.host_ok(), "intra_batch_topology": False,
              "kernel_backend": "pallas"})
+
+
+def _shardmap_mesh(w):
+    """A (1, 1) mesh + registered key: the shard_map twins trace on a
+    single-device mesh exactly like the meshable @mesh variants — the
+    census environment has one CPU device, and the program STRUCTURE
+    (explicit collectives, replicated vs tiled surface) is what the
+    manifest rows pin, not the device count."""
+    from kubetpu.parallel import mesh as pmesh
+    from kubetpu.parallel import shardmap
+    m = pmesh.make_mesh((1, 1))
+    return m, shardmap.register_mesh(m)
+
+
+def _shardmap_place(w, m):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    from kubetpu.parallel import mesh as pmesh
+    cluster = pmesh.shard_cluster(w.cluster, m)
+    batch = pmesh.shard_batch(w.batch, m)
+    rng = pmesh._put(w.rng, NamedSharding(m, PartitionSpec()))
+    return cluster, batch, rng
+
+
+def _shardmap_gang_replicated(w):
+    from kubetpu.parallel import shardmap
+    m, key = _shardmap_mesh(w)
+    cluster, batch, rng = _shardmap_place(w, m)
+    # the serving call form for topology batches (scheduler needs_topo
+    # routes intra_batch_topology=True -> surface "replicated")
+    return (shardmap._shardmap_gang, (cluster, batch, w.cfg, rng),
+            {"mesh_key": key, "intra_batch_topology": True,
+             "residual_window": 512, "surface": "replicated"})
+
+
+def _shardmap_gang_tiled(w):
+    from kubetpu.parallel import shardmap
+    m, key = _shardmap_mesh(w)
+    cluster, batch, rng = _shardmap_place(w, m)
+    # the term-free scale surface: gather-free tiled auction
+    return (shardmap._shardmap_gang, (cluster, batch, w.cfg, rng),
+            {"mesh_key": key, "intra_batch_topology": False,
+             "residual_window": 512, "surface": "tiled"})
+
+
+def _shardmap_sequential(w):
+    from kubetpu.parallel import shardmap
+    m, key = _shardmap_mesh(w)
+    cluster, batch, rng = _shardmap_place(w, m)
+    return (shardmap._shardmap_sequential,
+            (cluster, batch, _seq_cfg(w), rng),
+            {"mesh_key": key, "hard_pod_affinity_weight": 1.0,
+             "start_index": 0})
+
+
+def _shardmap_delta(w, donate):
+    import jax
+    from kubetpu.parallel import mesh as pmesh
+    from kubetpu.parallel import shardmap
+    m, key = _shardmap_mesh(w)
+    cluster = pmesh.shard_cluster(w.cluster, m)
+    delta = pmesh.replicate(
+        jax.tree.map(jax.numpy.asarray, _cluster_delta(w)), m)
+    fn = (shardmap._shardmap_apply_delta_donated if donate
+          else shardmap._shardmap_apply_delta_shared)
+    return fn, (cluster, delta), {"mesh_key": key}
+
+
+def _shardmap_delta_donated(w):
+    return _shardmap_delta(w, True)
+
+
+def _shardmap_delta_shared(w):
+    return _shardmap_delta(w, False)
 
 
 def _seq_cfg(w):
@@ -523,6 +603,39 @@ ENTRIES: List[Entry] = [
           _densify_pod_kv, tag="pod_kv", static_argnames=("L",)),
     Entry("_volume_mask", "kubetpu.state.volumes:_volume_mask",
           _volume_mask, static_argnames=()),
+    # ---- pod-axis mesh scale-out (parallel/shardmap.py): the explicit
+    # shard_map programs the mesh serving path dispatches — the legacy
+    # gspmd twins above (meshable @mesh variants) cover the OLD lowering
+    Entry("_shardmap_gang", "kubetpu.parallel.shardmap:_shardmap_gang",
+          _shardmap_gang_replicated, tag="replicated",
+          keep_sharding=True, static_argnums=(2,),
+          static_argnames=("mesh_key", "intra_batch_topology",
+                           "residual_window", "surface")),
+    Entry("_shardmap_gang", "kubetpu.parallel.shardmap:_shardmap_gang",
+          _shardmap_gang_tiled, tag="tiled", keep_sharding=True,
+          static_argnums=(2,),
+          static_argnames=("mesh_key", "intra_batch_topology",
+                           "residual_window", "surface")),
+    Entry("_shardmap_sequential",
+          "kubetpu.parallel.shardmap:_shardmap_sequential",
+          _shardmap_sequential, keep_sharding=True, static_argnums=(2,),
+          static_argnames=("mesh_key",)),
+    Entry("_apply_delta_body",
+          "kubetpu.parallel.shardmap:_apply_delta_body",
+          _shardmap_delta_donated, tag="donated", donate_argnums=(0,),
+          keep_sharding=True, static_argnames=("mesh_key",),
+          exempt=(("census/donation-unconsumed",
+                   "by design, the shard_map twin of the gspmd scatter's "
+                   "audited case: the four vocab-side tables are REPLACED "
+                   "wholesale from the replicated delta args, so their "
+                   "donated twins have no output to alias into; shard_map "
+                   "boundary resharding can further reduce the aliased "
+                   "count — the [N,.]/[P,.] residents are the bytes that "
+                   "matter and the scatter is correct either way"),)),
+    Entry("_apply_delta_body",
+          "kubetpu.parallel.shardmap:_apply_delta_body",
+          _shardmap_delta_shared, tag="shared", keep_sharding=True,
+          static_argnames=("mesh_key",)),
 ]
 
 
