@@ -5,13 +5,14 @@
 .PHONY: help lint lock-graph test sanitize-test race-test flight-test \
 	delta-test census census-test aot aot-test pallas-test chaos-test \
 	slo-test pipeline-test journal-test replay-test devstats-test \
-	mesh-test trend trace bench
+	mesh-test exact exact-test trend trace bench
 
 help:
 	@echo "kubetpu targets:"
-	@echo "  make lint           kubelint over kubetpu/ (all 5 rule families:"
+	@echo "  make lint           kubelint over kubetpu/ (all 7 rule families:"
 	@echo "                      host-sync, recompile, numeric, purity,"
-	@echo "                      concurrency), JSON CI mode, nonzero on findings"
+	@echo "                      concurrency, delta, exact), JSON CI mode,"
+	@echo "                      nonzero on findings"
 	@echo "  make lock-graph     print the lock-ownership map + acquisition-"
 	@echo "                      order table (README 'Concurrency model')"
 	@echo "  make test           tier-1 suite (JAX on CPU, slow tests skipped)"
@@ -84,6 +85,16 @@ help:
 	@echo "                      auction/scan (tiled + replicated surfaces,"
 	@echo "                      windowed rounds, serving path incl. the"
 	@echo "                      double-buffered batch upload)"
+	@echo "  make exact          re-prove the exact-reduction invariant over"
+	@echo "                      every mesh/Pallas root and rewrite the"
+	@echo "                      committed EXACT_MANIFEST.json (tools/"
+	@echo "                      kubeexact --write); run after an INTENTIONAL"
+	@echo "                      collective/VMEM surface change"
+	@echo "  make exact-test     exactness prover suite: every prover rule"
+	@echo "                      fires on a bad snippet, clean snippet empty,"
+	@echo "                      manifest byte-idempotence + drift gate,"
+	@echo "                      stale-exemption audit, committed manifest"
+	@echo "                      passes the pure-JSON --check"
 	@echo "  make trend          per-case bench trend table over the committed"
 	@echo "                      BENCH_r*.json trajectory with per-stage"
 	@echo "                      regression attribution (tools/benchtrend.py)"
@@ -217,6 +228,20 @@ replay-test:
 devstats-test:
 	JAX_PLATFORMS=cpu python -m pytest \
 		tests/test_devstats.py -q -p no:cacheprovider
+
+# jaxpr-level exactness prover + collective/VMEM census (tools/
+# kubeexact): abstract interpretation of every exact-marked mesh/Pallas
+# root proves each cross-shard/cross-tile reduction is float max/min or
+# an integer-valued sum bounded below 2**24, enumerates the collective
+# surface, and budgets the Pallas kernel's VMEM; --write rewrites the
+# committed EXACT_MANIFEST.json (byte-identical when the surface is
+# unchanged).  `make lint` / ci_lint.sh fail on drift.
+exact:
+	JAX_PLATFORMS=cpu python -m tools.kubeexact --write
+
+exact-test:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_kubeexact.py -q -p no:cacheprovider
 
 # bench trend table + regression attribution over the committed rounds
 trend:

@@ -42,6 +42,76 @@ def _idiv(a, b):
 
 
 # ---------------------------------------------------------------------------
+# blessed exact cross-axis reductions
+#
+# The only sanctioned ways to reduce across shard_map mesh axes or Pallas
+# grid tiles (kubelint exact/raw-collective-reduce + exact/raw-tie-argmax
+# route every call site here; tools/kubeexact proves the discipline on the
+# traced jaxprs).  The contract:
+#
+#   * float max/min are exactly associative — any tile order, same bits;
+#   * float sums must be integer-valued with |value| < 2**24 (callers are
+#     responsible; kubeexact checks the bound at north-star shapes);
+#   * tie-broken argmax must decompose through the per-pod gumbel plane
+#     (argmax over where(tie, gumbel, neg) == jax.random.categorical over
+#     the tie set) and cross-axis selection must fold (best, gumbel,
+#     lowest-index) by STRICT improvement so the winner equals the
+#     replicated jnp.argmax bit-for-bit.
+#
+# Sentinels (neg) ride in from the caller: the lax twin uses
+# jnp.float32(-2**62) while the Pallas kernel uses the python float — the
+# weak-type difference is part of each program's committed lowering.
+
+
+def exact_psum(x, axis_name):
+    """Cross-shard sum under the integer-exactness contract (int dtypes,
+    or integer-valued f32 with range < 2**24 — see tools/kubeexact)."""
+    return jax.lax.psum(x, axis_name)
+
+
+def exact_pmax(x, axis_name):
+    """Cross-shard float/int max: exactly associative, always bit-stable."""
+    return jax.lax.pmax(x, axis_name)
+
+
+def exact_pmin(x, axis_name):
+    """Cross-shard float/int min: exactly associative, always bit-stable."""
+    return jax.lax.pmin(x, axis_name)
+
+
+def gumbel_tiebreak_argmax(total, f, gumbel, col_offset, neg):
+    """Per-tile propose half of the selectHost decomposition.
+
+    Masks infeasible columns to ``neg``, takes the tile max, then breaks
+    exact score ties by gumbel (argmax over where(tie, gumbel, neg) is
+    jax.random.categorical restricted to the tie set — selectHost's
+    reservoir draw).  Returns (tile_best, tile_h, tile_arg) with
+    tile_arg offset into global column space by ``col_offset``;
+    jnp.argmax keeps the lowest index on exact gumbel ties, which is the
+    first-index contract the cross-axis fold preserves."""
+    masked = jnp.where(f, total, neg)
+    tile_best = jnp.max(masked, axis=1)
+    h = jnp.where((masked == tile_best[:, None]) & f, gumbel, neg)
+    tile_h = jnp.max(h, axis=1)
+    tile_arg = jnp.argmax(h, axis=1).astype(jnp.int32) + col_offset
+    return tile_best, tile_h, tile_arg
+
+
+def crossaxis_first_index_argmax(tile_best, tile_h, tile_arg, axis_name,
+                                 neg):
+    """Cross-shard resolve half: max score, then max gumbel among score
+    ties, then MIN global index among exact (score, gumbel) ties — all
+    via exactly-associative pmax/pmin, so the winner is the index the
+    replicated jnp.argmax would have chosen (gather-free)."""
+    best = jax.lax.pmax(tile_best, axis_name)
+    gh = jax.lax.pmax(jnp.where(tile_best == best, tile_h, neg),
+                      axis_name)
+    cand = jnp.where((tile_best == best) & (tile_h == gh), tile_arg,
+                     jnp.int32(2 ** 30))
+    return best, jax.lax.pmin(cand, axis_name)
+
+
+# ---------------------------------------------------------------------------
 # shared aggregation helpers
 
 

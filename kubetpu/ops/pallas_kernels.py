@@ -226,6 +226,49 @@ def _layout(cfg, has_bias: bool, W: int, N: int, R: int, P: int,
         NT=-(-N // TN))
 
 
+class Buf(NamedTuple):
+    """One kernel buffer: a BlockSpec'd input/output or a VMEM scratch.
+    ``shape`` is the BLOCK shape for in/out (full shape for scratch);
+    ``index`` gives the grid->block index map per dim ("b" = pod-block
+    axis, "n" = node-tile axis, "z" = pinned 0)."""
+    name: str
+    kind: str                  # "in" | "out" | "scratch"
+    shape: Tuple[int, ...]
+    dtype: str
+    index: Tuple[str, ...] = ()
+
+
+def kernel_buffers(L: _Layout, WB: int) -> Tuple[Buf, ...]:
+    """The kernel's full buffer table, in pallas_call operand order.
+    Single source of truth: propose() builds its BlockSpecs/out_shape/
+    scratch_shapes from this, and tools/kubeexact computes the static
+    VMEM budget from the same rows — the gate can never drift from the
+    traced program."""
+    Wpad = WB * L.TB
+    return (
+        Buf("planes", "in", (len(L.planes), L.TB, L.TN), "float32",
+            ("z", "b", "n")),
+        Buf("mask", "in", (L.TB, L.TN), "bool", ("b", "n")),
+        Buf("alloc", "in", (L.TN, L.R), "float32", ("n", "z")),
+        Buf("zone", "in", (L.TN, L.Z), "float32", ("n", "z")),
+        Buf("req", "in", (L.TN, L.R), "float32", ("n", "z")),
+        Buf("nz", "in", (L.TN, 2), "float32", ("n", "z")),
+        Buf("ports_used", "in", (L.TN, L.P), "float32", ("n", "z")),
+        Buf("breq", "in", (L.TB, L.R), "float32", ("b", "z")),
+        Buf("bnz", "in", (L.TB, 2), "float32", ("b", "z")),
+        Buf("bports", "in", (L.TB, L.P), "float32", ("b", "z")),
+        Buf("live", "in", (L.TB,), "bool", ("b",)),
+        Buf("skip", "in", (L.TB,), "bool", ("b",)),
+        Buf("ipa_any", "in", (L.TB,), "bool", ("b",)),
+        Buf("prop", "out", (L.TB,), "int32", ("b",)),
+        Buf("best", "out", (L.TB,), "float32", ("b",)),
+        Buf("act", "out", (L.TB,), "bool", ("b",)),
+        Buf("stats", "scratch", (Wpad, L.n_stats), "float32"),
+        Buf("czone", "scratch", (Wpad, L.Z), "float32"),
+        Buf("idxs", "scratch", (Wpad,), "int32"),
+    )
+
+
 def _make_kernel(L: _Layout):
     """Build the kernel body for one static layout.  Phase 0 sweeps the
     node tiles accumulating the per-pod normalization statistics; phase 1
@@ -426,13 +469,11 @@ def _make_kernel(L: _Layout):
                 total = total + jnp.where(f, s, 0.0) * weight
             if "bias" in plane:
                 total = total + planes_ref[plane["bias"]]
-            masked = jnp.where(f, total, _NEG)
-            tile_best = jnp.max(masked, axis=1)
             gum = planes_ref[plane["gumbel"]]
-            h = jnp.where((masked == tile_best[:, None]) & f, gum, _NEG)
-            tile_h = jnp.max(h, axis=1)
-            tile_arg = (jnp.argmax(h, axis=1).astype(jnp.int32)
-                        + n * L.TN)
+            # blessed gumbel decomposition (ops/kernels.py): same tuple
+            # the shard_map tiled surface folds across the node axis
+            tile_best, tile_h, tile_arg = K.gumbel_tiebreak_argmax(
+                total, f, gum, n * L.TN, _NEG)
 
             @pl.when(n == 0)
             def _():
@@ -495,42 +536,33 @@ def propose(bundle: Dict[str, jnp.ndarray], cfg, live: jnp.ndarray,
         return jnp.pad(x, [(0, 0), (0, Wpad - x.shape[1]), (0, 0)],
                        constant_values=fill)
 
-    S = bundle["planes"].shape[0]
     kernel = _make_kernel(L)
     grid = (2, WB, L.NT)
+    bufs = kernel_buffers(L, WB)
+
+    def spec(bf: Buf) -> "pl.BlockSpec":
+        dims = bf.index
+        return pl.BlockSpec(
+            bf.shape,
+            lambda p, b, n, dims=dims: tuple(
+                b if t == "b" else n if t == "n" else 0 for t in dims))
+
+    # an out's full shape tiles its block over the grid axes it indexes
+    def full(bf: Buf) -> Tuple[int, ...]:
+        mult = {"b": WB, "n": L.NT, "z": 1}
+        return tuple(d * mult[t] for d, t in zip(bf.shape, bf.index))
+
     prop, best, act = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((S, L.TB, L.TN), lambda p, b, n: (0, b, n)),
-            pl.BlockSpec((L.TB, L.TN), lambda p, b, n: (b, n)),
-            pl.BlockSpec((L.TN, R), lambda p, b, n: (n, 0)),
-            pl.BlockSpec((L.TN, Z), lambda p, b, n: (n, 0)),
-            pl.BlockSpec((L.TN, R), lambda p, b, n: (n, 0)),
-            pl.BlockSpec((L.TN, 2), lambda p, b, n: (n, 0)),
-            pl.BlockSpec((L.TN, P), lambda p, b, n: (n, 0)),
-            pl.BlockSpec((L.TB, R), lambda p, b, n: (b, 0)),
-            pl.BlockSpec((L.TB, 2), lambda p, b, n: (b, 0)),
-            pl.BlockSpec((L.TB, P), lambda p, b, n: (b, 0)),
-            pl.BlockSpec((L.TB,), lambda p, b, n: (b,)),
-            pl.BlockSpec((L.TB,), lambda p, b, n: (b,)),
-            pl.BlockSpec((L.TB,), lambda p, b, n: (b,)),
-        ],
-        out_specs=(
-            pl.BlockSpec((L.TB,), lambda p, b, n: (b,)),
-            pl.BlockSpec((L.TB,), lambda p, b, n: (b,)),
-            pl.BlockSpec((L.TB,), lambda p, b, n: (b,)),
-        ),
-        out_shape=(
-            jax.ShapeDtypeStruct((Wpad,), jnp.int32),
-            jax.ShapeDtypeStruct((Wpad,), jnp.float32),
-            jax.ShapeDtypeStruct((Wpad,), jnp.bool_),
-        ),
+        in_specs=[spec(bf) for bf in bufs if bf.kind == "in"],
+        out_specs=tuple(spec(bf) for bf in bufs if bf.kind == "out"),
+        out_shape=tuple(
+            jax.ShapeDtypeStruct(full(bf), jnp.dtype(bf.dtype))
+            for bf in bufs if bf.kind == "out"),
         scratch_shapes=[
-            pltpu.VMEM((Wpad, L.n_stats), jnp.float32),
-            pltpu.VMEM((Wpad, Z), jnp.float32),
-            pltpu.VMEM((Wpad,), jnp.int32),
-        ],
+            pltpu.VMEM(bf.shape, jnp.dtype(bf.dtype))
+            for bf in bufs if bf.kind == "scratch"],
         interpret=interpret,
     )(
         padw1(bundle["planes"]), padw(bundle["mask"]),

@@ -322,31 +322,33 @@ def _gang_tiled(cluster, batch, cfg, rng, mesh, host_ok, score_bias,
             under psum below 2**24 — the Pallas cross-tile argument,
             verbatim."""
             st = {}
-            st["act"] = lax.pmax(jnp.max(f.astype(jnp.float32), axis=1),
-                                 AXIS_NODES)
+            st["act"] = K.exact_pmax(
+                jnp.max(f.astype(jnp.float32), axis=1), AXIS_NODES)
             names = {n for n, _ in scores_static}
             if "NodeAffinity" in names:
                 raw = planes[plane["raw:NodeAffinity"]]
-                st["max_na"] = lax.pmax(
+                st["max_na"] = K.exact_pmax(
                     jnp.max(jnp.where(f, raw, _NEG), axis=1), AXIS_NODES)
             if "TaintToleration" in names:
                 raw = planes[plane["raw:TaintToleration"]]
-                st["max_tt"] = lax.pmax(
+                st["max_tt"] = K.exact_pmax(
                     jnp.max(jnp.where(f, raw, _NEG), axis=1), AXIS_NODES)
             if "InterPodAffinity" in names:
                 raw = planes[plane["ipa_raw"]]
-                st["max_ip"] = lax.pmax(
+                st["max_ip"] = K.exact_pmax(
                     jnp.max(jnp.where(f, raw, _NEG), axis=1), AXIS_NODES)
-                st["min_ip"] = lax.pmin(
+                st["min_ip"] = K.exact_pmin(
                     jnp.min(jnp.where(f, raw, -_NEG), axis=1), AXIS_NODES)
             if "DefaultPodTopologySpread" in names:
                 raw = planes[plane["dps_raw"]]
-                st["max_dps"] = lax.pmax(
+                st["max_dps"] = K.exact_pmax(
                     jnp.max(jnp.where(f, raw, _NEG), axis=1), AXIS_NODES)
-                st["havez"] = lax.pmax(
+                st["havez"] = K.exact_pmax(
                     jnp.max((f & has_zone[None, :]).astype(jnp.float32),
                             axis=1), AXIS_NODES)
-                st["czone"] = lax.psum(
+                # integer-valued f32 counts: exact under psum below 2**24
+                # (tools/kubeexact proves the bound at north-star shapes)
+                st["czone"] = K.exact_psum(
                     jnp.dot(jnp.where(f, raw, 0.0), zone_t,
                             preferred_element_type=jnp.float32),
                     AXIS_NODES)
@@ -442,21 +444,15 @@ def _gang_tiled(cluster, batch, cfg, rng, mesh, host_ok, score_bias,
             f = feas_tile(c, live)
             st = stats_for(f)
             total = combine(c, f, st)
-            masked = jnp.where(f, total, _NEG)
-            tile_best = jnp.max(masked, axis=1)
-            h = jnp.where((masked == tile_best[:, None]) & f, gum_t, _NEG)
-            tile_h = jnp.max(h, axis=1)
-            tile_arg = jnp.argmax(h, axis=1).astype(jnp.int32) + no
             # gather-free cross-shard argmax, first-index tie-break:
-            # strict-improvement on (best, gumbel) like the Pallas
-            # cross-tile fold, then MIN global index among exact ties —
-            # the earliest index IS jnp.argmax's choice
-            best = lax.pmax(tile_best, AXIS_NODES)
-            gh = lax.pmax(jnp.where(tile_best == best, tile_h, _NEG),
-                          AXIS_NODES)
-            cand = jnp.where((tile_best == best) & (tile_h == gh),
-                             tile_arg, jnp.int32(2**30))
-            gidx = lax.pmin(cand, AXIS_NODES)
+            # per-tile gumbel decomposition then MIN global index among
+            # exact (score, gumbel) ties — the earliest index IS
+            # jnp.argmax's choice (blessed ops/kernels.py pair; the
+            # Pallas kernel folds the same tuple across grid tiles)
+            tile_best, tile_h, tile_arg = K.gumbel_tiebreak_argmax(
+                total, f, gum_t, no, _NEG)
+            best, gidx = K.crossaxis_first_index_argmax(
+                tile_best, tile_h, tile_arg, AXIS_NODES, _NEG)
             active_l = st["act"] > 0
             prop_l = jnp.where(active_l, gidx, N).astype(jnp.int32)
             # collective host resolution: winners to every device, then
@@ -534,11 +530,12 @@ def _gang_tiled(cluster, batch, cfg, rng, mesh, host_ok, score_bias,
 
         f0 = out["feas0"]
         n_feas = lax.all_gather(
-            lax.psum(jnp.sum(f0.astype(jnp.int32), axis=1), AXIS_NODES),
+            K.exact_psum(jnp.sum(f0.astype(jnp.int32), axis=1),
+                         AXIS_NODES),
             AXIS_PODS, tiled=True)
         base_t = nv_t[None, :] & valid_l[:, None]
         au_l = jnp.all(unres_t | f0 | ~base_t, axis=1)
-        au_l = lax.pmin(au_l.astype(jnp.int32), AXIS_NODES) > 0
+        au_l = K.exact_pmin(au_l.astype(jnp.int32), AXIS_NODES) > 0
         all_unres = lax.all_gather(au_l, AXIS_PODS, tiled=True)
         return (out["assigned"], out["win_score"], out["rounds"],
                 out["req"], out["nz"], out["ports_used"], f0, n_feas,

@@ -218,6 +218,12 @@ def manifest_costs(path: Optional[str] = None) -> Dict[str, dict]:
                 "variant": row.get("variant"),
                 "lowering_sha256": (row.get("lowering_sha256") or "")[:16],
             }
+            # per-collective DCN bytes (joined into the census row from
+            # EXACT_MANIFEST.json): lets the roofline split arithmetic
+            # bandwidth from cross-device transfer per program
+            xb = cost.get("collective_bytes")
+            if isinstance(xb, dict):
+                out[prog]["collective_bytes"] = xb
     if path is None:
         with _manifest_lock:
             _manifest_cache = out

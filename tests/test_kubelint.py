@@ -1091,3 +1091,97 @@ def test_detects_at_least_four_rule_families():
 def test_concurrency_family_registered():
     from tools.kubelint import RULE_FAMILIES
     assert "concurrency" in RULE_FAMILIES
+
+
+# ---------------------------------------------------------------------------
+# exact family: raw collectives + raw tie-argmax (source half of the
+# kubeexact exactness contract)
+
+
+def test_raw_collective_reduce_fires_anywhere(tmp_path):
+    src = """
+import jax
+
+def auction(scores):
+    return jax.lax.psum(scores, "pods")
+"""
+    res = lint_snippet(tmp_path, src, rules=["exact"])
+    assert rule_ids(res) == ["exact/raw-collective-reduce"]
+    assert "exact_psum" in res.findings[0].message
+
+
+def test_raw_collective_quiet_in_blessed_module(tmp_path):
+    src = """
+import jax
+
+def exact_psum(x, axis):
+    return jax.lax.psum(x, axis)
+"""
+    d = tmp_path / "kubetpu" / "ops"
+    d.mkdir(parents=True)
+    f = d / "kernels.py"
+    f.write_text(src)
+    res = run_lint([str(f)], root=str(tmp_path), rules=["exact"])
+    assert res.clean, [str(x) for x in res.findings]
+
+
+def test_raw_tie_argmax_fires_only_in_selection_modules(tmp_path):
+    src = """
+import jax.numpy as jnp
+
+def pick(scores):
+    return jnp.argmax(scores, axis=-1)
+"""
+    d = tmp_path / "kubetpu" / "parallel"
+    d.mkdir(parents=True)
+    f = d / "shardmap.py"
+    f.write_text(src)
+    res = run_lint([str(f)], root=str(tmp_path), rules=["exact"])
+    assert rule_ids(res) == ["exact/raw-tie-argmax"]
+    # the same argmax in a non-selection module is a local utility
+    res = lint_snippet(tmp_path, src, rules=["exact"])
+    assert res.clean, [str(x) for x in res.findings]
+
+
+def test_exact_family_registered():
+    from tools.kubelint import RULE_FAMILIES
+    assert "exact" in RULE_FAMILIES
+
+
+# ---------------------------------------------------------------------------
+# per-rule suppression staleness
+
+
+def test_partially_stale_suppression_is_reported(tmp_path):
+    src = """
+import jax
+
+@jax.jit
+def f(x, w):
+    # only host-sync/cast fires below: the numeric/f64 id is dead weight
+    return x * float(w)  # kubelint: ignore[host-sync/cast, numeric/f64] static weight
+"""
+    res = lint_snippet(tmp_path, src)
+    stale = [f for f in res.findings
+             if f.rule == "kubelint/stale-suppression"]
+    assert stale and "numeric/f64" in stale[0].message
+    # the live half still suppresses its finding
+    assert any(f.rule == "host-sync/cast" for f in res.suppressed)
+    assert not any(f.rule == "kubelint/unused-suppression"
+                   for f in res.findings)
+
+
+def test_fully_live_multirule_suppression_is_quiet(tmp_path):
+    src = """
+import jax
+import numpy as np
+
+@jax.jit
+def f(x, w):
+    # kubelint: ignore[host-sync/cast] w is a static weight
+    return x * float(w)
+"""
+    res = lint_snippet(tmp_path, src)
+    assert not any(f.rule in ("kubelint/stale-suppression",
+                              "kubelint/unused-suppression")
+                   for f in res.findings)

@@ -1,7 +1,10 @@
 #!/bin/sh
 # CI lint gate: kubelint in JSON mode, nonzero exit on any unsuppressed
-# finding.  Covers all six rule families — host-sync, recompile, numeric,
-# purity, concurrency (lock discipline for the threaded host path,
+# finding.  Covers all seven rule families — host-sync, recompile,
+# numeric, purity, exact (raw lax collectives / raw tie-argmax must
+# route through the blessed ops/kernels.py helpers so tools/kubeexact
+# can prove the reduction surface),
+# concurrency (lock discipline for the threaded host path,
 # including the flight-recorder classes: utils/trace.py FlightRecorder /
 # CycleRecord and utils/decisions.py DecisionLog are guarded-by annotated
 # and must stay tree-clean), and delta (incremental-tensorization
@@ -62,6 +65,12 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m tools.kubecensus --check --json
 # manifest row, or a manifest row with no artifact at census rungs,
 # fails.  Regenerate after an intentional surface change: make aot.
 python -m tools.kubeaot --check --json
+# Exactness manifest gate, pure-JSON half (tools/kubeexact --check, no
+# jax): the committed EXACT_MANIFEST.json must pin the northstar
+# environment and constants, keep every proof exact/exempt with margin
+# above the 4x floor, re-derive its VMEM totals from the committed
+# buffer rows, and name only programs COMPILE_MANIFEST.json licenses.
+python -m tools.kubeexact --check --json
 # Pallas megakernel bit-match oracle (ops/pallas_kernels.py): the
 # interpret-mode differential suite on CPU — lax vs pallas GangResults
 # must be bit-identical on randomized churned clusters, the committed
@@ -121,6 +130,22 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
 # lock poison test, armed-vs-disarmed placement parity golden).
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
 	tests/test_devstats.py -q -m 'not slow' -p no:cacheprovider
+# Exactness prover gate, full half (tools/kubeexact): re-traces every
+# exact-marked mesh/Pallas root, re-proves each cross-shard/cross-tile
+# reduction exact (float max/min or int-valued sum < 2**24 via the
+# integer-valuedness + interval lattice), re-enumerates the collective
+# surface and the Pallas VMEM budget, and fails on any unsuppressed
+# exact/* finding, a stale exemption, or DRIFT against the committed
+# EXACT_MANIFEST.json in either direction.  Regenerate after an
+# intentional change: make exact (python -m tools.kubeexact --write).
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m tools.kubeexact --json
+# Exactness prover suite: every prover rule fires on a seeded bad
+# snippet (non-integer f32 psum, out-of-range sum, shard_map row-
+# gather, raw tie-argmax, VMEM over budget), clean snippets stay empty,
+# manifest regeneration is byte-identical, the drift gate sees both
+# directions, and exemption staleness is audited.
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
+	tests/test_kubeexact.py -q -m 'not slow' -p no:cacheprovider
 # Bench-trend CI check (tools/benchtrend.py, pure JSON, no jax): the
 # committed BENCH_r*/MULTICHIP_r* trajectory must stay schema-compatible
 # with the trend tooling, and the newest parseable round must not

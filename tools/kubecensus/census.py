@@ -160,6 +160,10 @@ def trace_variant(entry: Entry, rung: Rung, mesh: bool = False) -> Variant:
     out_avals = _out_avals(lowered, fn, abs_args, entry.static_argnums,
                            abs_dyn, static_kw)
     cost = _cost(lowered)
+    xb = _collective_bytes(entry, rung)
+    if xb is not None:
+        cost = dict(cost or {})
+        cost["collective_bytes"] = xb
     n_donated = 0
     if entry.donate_argnums:
         n_donated = sum(
@@ -247,6 +251,37 @@ def _out_avals(lowered, fn, abs_args, static_argnums, abs_dyn, static_kw):
     return jax.eval_shape(
         _closure(fn, abs_args, static_argnums, list(abs_dyn), static_kw),
         *(tuple(dyn_pos) + tuple(abs_dyn.values())))
+
+
+_exact_surface_cache: Optional[dict] = None
+
+
+def _collective_bytes(entry: Entry, rung: Rung) -> Optional[dict]:
+    """Per-collective DCN byte attribution for this variant, joined from
+    the committed exactness surface (EXACT_MANIFEST.json, written by
+    ``python -m tools.kubeexact --write``).  Lets devstats/benchtrend
+    split a program's roofline into arithmetic vs cross-device transfer.
+    Programs outside the exactness registry (or a missing manifest)
+    contribute nothing — never an error."""
+    global _exact_surface_cache
+    if _exact_surface_cache is None:
+        try:
+            from tools.kubeexact.manifest import load_manifest
+            _exact_surface_cache = load_manifest() or {}
+        except Exception:
+            _exact_surface_cache = {}
+    key = entry.program + (":" + entry.tag if entry.tag else "")
+    prog = (_exact_surface_cache.get("programs") or {}).get(key)
+    if prog is None:
+        return None
+    rows = (prog.get("surface") or {}).get(rung.name)
+    if rows is None:
+        return None
+    by_op: Dict[str, int] = {}
+    for r in rows:
+        by_op[r["op"]] = by_op.get(r["op"], 0) + int(r.get("bytes", 0))
+    return {"total_bytes": sum(by_op.values()), "ops": len(rows),
+            "by_op": by_op}
 
 
 def _cost(lowered) -> Optional[dict]:

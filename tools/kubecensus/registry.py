@@ -187,6 +187,25 @@ class Entry:
     static_argnums: Tuple[int, ...] = ()
     ladder: Tuple[Rung, ...] = DEFAULT_LADDER
     exempt: Tuple[Tuple[str, str], ...] = ()
+    # ---- exactness prover metadata (tools/kubeexact) -------------------
+    # exact=True opts the entry into the jaxpr-level exact-reduction
+    # proof: every cross-shard/cross-tile float reduction must be proved
+    # max/min or an integer-valued sum bounded below 2**24 at the
+    # north-star shapes.  The shard_map/Pallas family (the roots with
+    # collectives or grid-accumulator folds) must all be exact=True.
+    exact: bool = False
+    # (input-path substring, fact name): seeds the abstract interpreter
+    # with invariants the builders guarantee but tracing cannot see —
+    # e.g. cluster.zone_hot rows are one-hot ("onehot_rows").  Facts are
+    # part of the audited trust base and are committed in the manifest.
+    exact_facts: Tuple[Tuple[str, str], ...] = ()
+    # (rule, reason) exemptions for exactness findings, mirroring
+    # ``exempt``: reasonless or stale entries are themselves findings.
+    exact_exempt: Tuple[Tuple[str, str], ...] = ()
+    # symbol name per pallas grid axis ("" = literal grid size): lets the
+    # prover generalize a grid-axis fold count from the probe rung to the
+    # north-star environment (e.g. ("", "WB", "NT")).
+    exact_grid_syms: Tuple[str, ...] = ()
 
     @property
     def key(self) -> str:
@@ -567,11 +586,15 @@ ENTRIES: List[Entry] = [
           _schedule_gang_bias, tag="bias", static_argnums=(2,)),
     Entry("_schedule_gang", "kubetpu.models.gang:_schedule_gang",
           _schedule_gang_pallas, tag="pallas", static_argnums=(2,),
-          static_argnames=("intra_batch_topology", "kernel_backend")),
+          static_argnames=("intra_batch_topology", "kernel_backend"),
+          exact=True, exact_facts=(("zone_hot", "onehot_rows"),),
+          exact_grid_syms=("", "WB", "NT")),
     Entry("_schedule_gang", "kubetpu.models.gang:_schedule_gang",
           _schedule_gang_pallas_hostok, tag="pallas_hostok",
           static_argnums=(2,),
-          static_argnames=("intra_batch_topology", "kernel_backend")),
+          static_argnames=("intra_batch_topology", "kernel_backend"),
+          exact=True, exact_facts=(("zone_hot", "onehot_rows"),),
+          exact_grid_syms=("", "WB", "NT")),
     Entry("_schedule_sequential",
           "kubetpu.models.sequential:_schedule_sequential",
           _schedule_sequential, meshable=True, static_argnums=(2,)),
@@ -610,16 +633,22 @@ ENTRIES: List[Entry] = [
           _shardmap_gang_replicated, tag="replicated",
           keep_sharding=True, static_argnums=(2,),
           static_argnames=("mesh_key", "intra_batch_topology",
-                           "residual_window", "surface")),
+                           "residual_window", "surface"),
+          exact=True),
     Entry("_shardmap_gang", "kubetpu.parallel.shardmap:_shardmap_gang",
           _shardmap_gang_tiled, tag="tiled", keep_sharding=True,
           static_argnums=(2,),
           static_argnames=("mesh_key", "intra_batch_topology",
-                           "residual_window", "surface")),
+                           "residual_window", "surface"),
+          exact=True,
+          # SnapshotBuilder writes zone_hot as a one-hot zone-membership
+          # row per node (state/tensors.py); the zone-count psum's 2**24
+          # proof rests on this row-sum-==-1 invariant
+          exact_facts=(("zone_hot", "onehot_rows"),)),
     Entry("_shardmap_sequential",
           "kubetpu.parallel.shardmap:_shardmap_sequential",
           _shardmap_sequential, keep_sharding=True, static_argnums=(2,),
-          static_argnames=("mesh_key",)),
+          static_argnames=("mesh_key",), exact=True),
     Entry("_apply_delta_body",
           "kubetpu.parallel.shardmap:_apply_delta_body",
           _shardmap_delta_donated, tag="donated", donate_argnums=(0,),
@@ -631,11 +660,12 @@ ENTRIES: List[Entry] = [
                    "donated twins have no output to alias into; shard_map "
                    "boundary resharding can further reduce the aliased "
                    "count — the [N,.]/[P,.] residents are the bytes that "
-                   "matter and the scatter is correct either way"),)),
+                   "matter and the scatter is correct either way"),),
+          exact=True),
     Entry("_apply_delta_body",
           "kubetpu.parallel.shardmap:_apply_delta_body",
           _shardmap_delta_shared, tag="shared", keep_sharding=True,
-          static_argnames=("mesh_key",)),
+          static_argnames=("mesh_key",), exact=True),
 ]
 
 

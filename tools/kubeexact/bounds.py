@@ -1,0 +1,214 @@
+"""Symbolic bound expressions for the exactness prover.
+
+The prover traces each program once, at a small probe rung, but the
+invariant it certifies ("this f32 psum stays integer-exact") must hold at
+the north-star deployment shape.  So interval endpoints are not numbers:
+they are tiny closed-form expressions over named dimension symbols
+(``P`` = padded existing-pod capacity, ``N`` = padded node slots, ...)
+plus mesh/grid fan-in symbols, built structurally during abstract
+interpretation and evaluated twice — once at the probe rung (sanity) and
+once at the committed north-star environment (the headroom audit).
+
+Design constraints:
+
+  * expressions are immutable and *structurally deterministic*: the
+    rendered string is committed into EXACT_MANIFEST.json and must be
+    byte-identical across regenerations;
+  * a probe rung can alias two logical dims to the same size (existing
+    pods are one-per-node, so P and N pad to the same bucket at small
+    rungs).  A symbol therefore carries a *tuple* of candidate dim names
+    and evaluates to the max over them — a sound upper bound whichever
+    dim the size actually was;
+  * only the operations the lattice needs exist: +, *, max, min, consts
+    and infinity.  Constant folding keeps the committed strings short.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Tuple
+
+# Float sums of integer-valued terms are exact (any association order) as
+# long as every partial sum is representable: |sum| < 2**24 for f32.
+INT_EXACT_LIMIT = float(2 ** 24)
+
+INF = math.inf
+
+
+class Expr:
+    """An immutable bound expression: ("const", v) | ("sym", names) |
+    ("add"|"mul"|"max"|"min", a, b)."""
+
+    __slots__ = ("node",)
+
+    def __init__(self, node: tuple):
+        self.node = node
+
+    # ---- constructors -------------------------------------------------
+    @staticmethod
+    def const(v: float) -> "Expr":
+        return Expr(("const", float(v)))
+
+    @staticmethod
+    def sym(names) -> "Expr":
+        if isinstance(names, str):
+            names = (names,)
+        return Expr(("sym", tuple(names)))
+
+    # ---- algebra (constant-folding, identity-pruning) -----------------
+    def _const(self):
+        return self.node[1] if self.node[0] == "const" else None
+
+    def __add__(self, o: "Expr") -> "Expr":
+        a, b = self._const(), o._const()
+        if a is not None and b is not None:
+            return Expr.const(a + b)
+        if a == 0.0:
+            return o
+        if b == 0.0:
+            return self
+        if a == INF or b == INF:
+            return Expr.const(INF)
+        return Expr(("add", self.node, o.node))
+
+    def __mul__(self, o: "Expr") -> "Expr":
+        a, b = self._const(), o._const()
+        # 0 * x == 0 even against infinity: bounds multiply counts of
+        # nonnegative terms, never indeterminate forms
+        if a == 0.0 or b == 0.0:
+            return Expr.const(0.0)
+        if a is not None and b is not None:
+            return Expr.const(a * b)
+        if a == 1.0:
+            return o
+        if b == 1.0:
+            return self
+        if a == INF or b == INF:
+            return Expr.const(INF)
+        return Expr(("mul", self.node, o.node))
+
+    def emax(self, o: "Expr") -> "Expr":
+        a, b = self._const(), o._const()
+        if a is not None and b is not None:
+            return Expr.const(max(a, b))
+        if a == INF or b == INF:
+            return Expr.const(INF)
+        if a == -INF:
+            return o
+        if b == -INF:
+            return self
+        if self.node == o.node:
+            return self
+        return Expr(("max", self.node, o.node))
+
+    def emin(self, o: "Expr") -> "Expr":
+        a, b = self._const(), o._const()
+        if a is not None and b is not None:
+            return Expr.const(min(a, b))
+        if a == INF:
+            return o
+        if b == INF:
+            return self
+        if self.node == o.node:
+            return self
+        return Expr(("min", self.node, o.node))
+
+    def neg(self) -> "Expr":
+        return Expr.const(-1.0) * self
+
+    # ---- evaluation ---------------------------------------------------
+    def eval(self, env: Dict[str, float]) -> float:
+        return _eval(self.node, env)
+
+    @property
+    def is_finite(self) -> bool:
+        """Finite under an all-finite environment (no INF constants)."""
+        return _finite(self.node)
+
+    # ---- rendering (committed; must be deterministic) -----------------
+    def render(self) -> str:
+        return _render(self.node)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return "Expr(%s)" % self.render()
+
+    def __eq__(self, o) -> bool:
+        return isinstance(o, Expr) and self.node == o.node
+
+    def __hash__(self) -> int:
+        return hash(self.node)
+
+
+ZERO = Expr.const(0.0)
+ONE = Expr.const(1.0)
+TOP = Expr.const(INF)
+BOT = Expr.const(-INF)
+
+
+def _eval(node: tuple, env: Dict[str, float]) -> float:
+    kind = node[0]
+    if kind == "const":
+        return node[1]
+    if kind == "sym":
+        missing = [n for n in node[1] if n not in env]
+        if missing:
+            raise KeyError("bound symbol(s) %s not in environment %s"
+                           % (missing, sorted(env)))
+        return max(env[n] for n in node[1])
+    a, b = _eval(node[1], env), _eval(node[2], env)
+    if kind == "add":
+        return a + b
+    if kind == "mul":
+        if a == 0.0 or b == 0.0:
+            return 0.0
+        return a * b
+    if kind == "max":
+        return max(a, b)
+    if kind == "min":
+        return min(a, b)
+    raise ValueError("unknown Expr node %r" % (node,))
+
+
+def _finite(node: tuple) -> bool:
+    kind = node[0]
+    if kind == "const":
+        return math.isfinite(node[1])
+    if kind == "sym":
+        return True
+    return _finite(node[1]) and _finite(node[2])
+
+
+def _fmt_const(v: float) -> str:
+    if v == INF:
+        return "inf"
+    if v == -INF:
+        return "-inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def _render(node: tuple) -> str:
+    kind = node[0]
+    if kind == "const":
+        return _fmt_const(node[1])
+    if kind == "sym":
+        names = node[1]
+        return names[0] if len(names) == 1 else "max(%s)" % "|".join(names)
+    a, b = _render(node[1]), _render(node[2])
+    if kind == "add":
+        return "(%s + %s)" % (a, b)
+    if kind == "mul":
+        return "%s*%s" % (a, b)
+    return "%s(%s, %s)" % (kind, a, b)
+
+
+def sym_table(sizes: Dict[str, int]) -> Dict[int, Tuple[str, ...]]:
+    """size -> tuple of candidate dim names.  Small probe rungs alias
+    dims (P == N when existing pods are one-per-node); the aliased symbol
+    evaluates to the max over its candidates, which upper-bounds whichever
+    dim the size really was."""
+    table: Dict[int, list] = {}
+    for name in sorted(sizes):
+        table.setdefault(int(sizes[name]), []).append(name)
+    return {k: tuple(v) for k, v in table.items()}

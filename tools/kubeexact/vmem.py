@@ -1,0 +1,57 @@
+"""Static VMEM budget for the Pallas megakernel.
+
+The kernel's whole working set per grid step is knowable statically: the
+BlockSpec'd input/output blocks (double-buffered by the Mosaic pipeline —
+the next step's blocks stream in while the current step computes) plus
+the VMEM scratch accumulators (resident across the whole grid, counted
+once).  ops/pallas_kernels.kernel_buffers() is the single source of truth
+for both the traced pallas_call and this budget, so the gate cannot
+drift from the program.
+
+The budget is evaluated at the north-star layout — pod tile TB, node
+tile TN at their 128-lane caps, R/Z at their committed ceilings, the
+scratch rows spanning the full padded auction window — and gated against
+the v5e per-core VMEM capacity.  No jax imports: the committed numbers
+re-validate under ``--check`` without jax.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .northstar import VMEM_CAPACITY_BYTES
+
+_ITEMSIZE = {"bool": 1, "int8": 1, "bfloat16": 2, "float16": 2,
+             "float32": 4, "int32": 4, "uint32": 4}
+
+# in/out blocks are double-buffered by the pipeline; scratch is resident
+_PIPELINE_COPIES = {"in": 2, "out": 2, "scratch": 1}
+
+
+def budget(buffers: List[dict], capacity: int = VMEM_CAPACITY_BYTES) -> dict:
+    """``buffers``: rows with name/kind/shape/dtype (kernel_buffers() Bufs
+    or their manifest dicts).  Returns the per-buffer and total byte
+    ledger plus the fits-in-VMEM verdict."""
+    per = []
+    total = 0
+    for b in buffers:
+        name = b["name"] if isinstance(b, dict) else b.name
+        kind = b["kind"] if isinstance(b, dict) else b.kind
+        shape = b["shape"] if isinstance(b, dict) else b.shape
+        dtype = b["dtype"] if isinstance(b, dict) else b.dtype
+        n = 1
+        for d in shape:
+            n *= int(d)
+        copies = _PIPELINE_COPIES.get(kind, 1)
+        nbytes = n * _ITEMSIZE.get(dtype, 4) * copies
+        per.append({"name": name, "kind": kind,
+                    "shape": [int(d) for d in shape], "dtype": dtype,
+                    "copies": copies, "bytes": nbytes})
+        total += nbytes
+    return {
+        "buffers": per,
+        "total_bytes": total,
+        "capacity_bytes": int(capacity),
+        "utilization": round(total / float(capacity), 4),
+        "fits": total <= capacity,
+    }

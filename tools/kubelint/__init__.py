@@ -13,4 +13,4 @@ syntax; ``python -m tools.kubelint kubetpu/`` is the CLI.
 from .core import Finding, LintResult, run_lint  # noqa: F401
 
 RULE_FAMILIES = ("host-sync", "recompile", "numeric", "purity",
-                 "concurrency", "delta")
+                 "concurrency", "delta", "exact")

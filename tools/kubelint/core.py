@@ -13,6 +13,7 @@ so kubelint checks them mechanically.  One module per rule family:
     rules_purity       kernel-purity rules                (purity/*)
     rules_concurrency  host-path lock-discipline rules    (concurrency/*)
     rules_delta        incremental-tensorization rules    (delta/*)
+    rules_exact        exact-reduction discipline rules   (exact/*)
 
 Inline suppression syntax (reason is REQUIRED):
 
@@ -199,8 +200,9 @@ def run_lint(paths: Sequence[str], root: str = ".",
     """Lint every .py file under ``paths``.  ``rules``: optional rule-id
     prefixes to restrict to (e.g. ["host-sync"])."""
     from . import callgraph as cg
-    from . import (rules_concurrency, rules_delta, rules_host_sync,
-                   rules_numeric, rules_purity, rules_recompile)
+    from . import (rules_concurrency, rules_delta, rules_exact,
+                   rules_host_sync, rules_numeric, rules_purity,
+                   rules_recompile)
 
     modules = load_modules(paths, root=root)
     ctx = LintContext(modules)
@@ -210,7 +212,8 @@ def run_lint(paths: Sequence[str], root: str = ".",
     for mod in modules:
         raw.extend(mod.bad_suppressions)
         for rule_mod in (rules_host_sync, rules_recompile, rules_numeric,
-                         rules_purity, rules_concurrency, rules_delta):
+                         rules_purity, rules_concurrency, rules_delta,
+                         rules_exact):
             raw.extend(rule_mod.check(mod, ctx))
 
     if rules:
@@ -228,23 +231,37 @@ def run_lint(paths: Sequence[str], root: str = ".",
                else None)
         if sup is not None:
             f.suppressed, f.reason = True, sup.reason
-            used.add((f.path, id(sup)))
+            used.add((f.path, id(sup), f.rule))
             suppressed.append(f)
         else:
             findings.append(f)
     if not rules:
-        # a suppression matching no finding is stale: the exempted code was
-        # fixed or moved, and the comment now falsely documents an
-        # exemption.  (Skipped under a --rules filter, which hides the
+        # staleness is audited PER RULE ID, not per comment: a suppression
+        # naming [a, b] where only `a` still fires used to count as fully
+        # used, so the dead `b` kept documenting an exemption that no
+        # longer exists.  A comment where NO named rule fires is unused;
+        # one where SOME named rule no longer fires is stale for exactly
+        # those ids.  (Skipped under a --rules filter, which hides the
         # findings other families' suppressions legitimately cover.)
         for mod in modules:
             for sup in mod.suppressions:
-                if (mod.path, id(sup)) not in used:
+                fired = [r for r in sup.rules
+                         if (mod.path, id(sup), r) in used]
+                if not fired:
                     findings.append(Finding(
                         rule="kubelint/unused-suppression", path=mod.path,
                         line=sup.line, col=1,
                         message="suppression for %s matches no finding — "
                                 "remove the stale comment"
                                 % ", ".join(sup.rules)))
+                    continue
+                for r in sup.rules:
+                    if (mod.path, id(sup), r) not in used:
+                        findings.append(Finding(
+                            rule="kubelint/stale-suppression", path=mod.path,
+                            line=sup.line, col=1,
+                            message="suppression names %s but only %s still "
+                                    "fires on this line — drop the stale "
+                                    "rule id" % (r, ", ".join(fired))))
         findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return LintResult(findings=findings, suppressed=suppressed)
