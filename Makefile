@@ -5,7 +5,7 @@
 .PHONY: help lint lock-graph test sanitize-test race-test flight-test \
 	delta-test census census-test aot aot-test pallas-test chaos-test \
 	slo-test pipeline-test journal-test replay-test devstats-test \
-	mesh-test exact exact-test trend trace bench
+	mesh-test exact exact-test close close-test trend trace bench
 
 help:
 	@echo "kubetpu targets:"
@@ -95,6 +95,20 @@ help:
 	@echo "                      manifest byte-idempotence + drift gate,"
 	@echo "                      stale-exemption audit, committed manifest"
 	@echo "                      passes the pure-JSON --check"
+	@echo "  make close          re-prove the compile-surface closure (tools/"
+	@echo "                      kubeclose --write): interprocedural provenance"
+	@echo "                      of every dispatch-seam static, enumerated"
+	@echo "                      reachable signature set, coverage join against"
+	@echo "                      the kubecensus registry; rewrites the committed"
+	@echo "                      CLOSURE_MANIFEST.json (byte-identical over an"
+	@echo "                      unchanged tree); run after an INTENTIONAL seam"
+	@echo "                      or config-domain change"
+	@echo "  make close-test     closure prover suite: every close/* rule fires"
+	@echo "                      on a bad snippet + quiet good twin, manifest"
+	@echo "                      byte-idempotence + two-directional drift gate,"
+	@echo "                      --check under a jax import blocker, stale-"
+	@echo "                      exemption audit, serving-path dispatch-"
+	@echo "                      signature membership e2e"
 	@echo "  make trend          per-case bench trend table over the committed"
 	@echo "                      BENCH_r*.json trajectory with per-stage"
 	@echo "                      regression attribution (tools/benchtrend.py)"
@@ -242,6 +256,19 @@ exact:
 exact-test:
 	JAX_PLATFORMS=cpu python -m pytest \
 		tests/test_kubeexact.py -q -p no:cacheprovider
+
+# compile-surface closure prover (tools/kubeclose, pure AST — no jax):
+# interprocedural provenance of every value reaching a dispatch-seam
+# static, enumerated at the committed north-star environment and joined
+# against the kubecensus registry's closure_statics; --write rewrites
+# the committed CLOSURE_MANIFEST.json (byte-identical when the seam
+# surface is unchanged).  `make lint` / ci_lint.sh fail on drift.
+close:
+	python -m tools.kubeclose --write
+
+close-test:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_kubeclose.py -q -p no:cacheprovider
 
 # bench trend table + regression attribution over the committed rounds
 trend:

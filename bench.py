@@ -1681,16 +1681,21 @@ def main() -> None:
         failures = northstar_gate(detail)
         # census cross-check: every compile event the watchdog observed
         # for a REGISTERED kernel program must be a COMPILE_MANIFEST.json
-        # row (exact at census rungs, structural — same program/arity/
-        # dtypes/ranks — at serving shapes).  An "outside" event means the
-        # observed compile surface drifted from the committed census.
+        # row — exact at census rungs; at serving shapes, programs the
+        # committed closure (CLOSURE_MANIFEST.json) proves classify by
+        # closure membership (committed leaf structure + pow2-licensed
+        # dims under the north-star caps), everything else by the legacy
+        # structural heuristic.  An "outside" event means the observed
+        # compile surface drifted from the committed census/closure.
         if census_wd is not None:
             try:
-                from tools.kubecensus.manifest import (load_manifest,
+                from tools.kubecensus.manifest import (load_closure,
+                                                       load_manifest,
                                                        match_compile_events)
                 rows = load_manifest()
                 if rows:
-                    rep = match_compile_events(census_wd.counts, rows)
+                    rep = match_compile_events(census_wd.counts, rows,
+                                               closure=load_closure())
                     print(json.dumps({"census_check": rep}),
                           file=sys.stderr)
                     for ev in rep["outside"]:

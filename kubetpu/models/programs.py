@@ -452,9 +452,14 @@ def _apply_cluster_delta(cluster, delta):
     densify on device exactly like HostClusterArrays.to_device, so a
     delta-applied cluster stays byte-identical to a rebuild."""
     from ..state.tensors import _densify_ids
+    from ..utils.intern import pow2_bucket
 
     nr, pr = delta.node_rows, delta.pod_rows
-    L = cluster.kv.shape[1]
+    # kv width is always an InternTable .cap (pow2_bucket of the vocab, so a
+    # power of two >= 8) — re-bucketing is identity at runtime and proves to
+    # the closure engine that the static L of _densify_ids stays on the
+    # pow2 ladder.
+    L = pow2_bucket(cluster.kv.shape[1])
 
     def scat(x, rows, vals):
         return x.at[rows].set(vals, mode="drop")

@@ -333,11 +333,24 @@ def test_serving_buckets_and_allows_bucket(tmp_path):
     assert aot.AotRuntime(empty, mode="serve").allows_bucket(128)
 
 
+def _write_closure(path, keys):
+    """A minimal CLOSURE_MANIFEST.json whose combos cover exactly
+    ``keys`` (registry entry keys, "program" or "program:tag")."""
+    programs = {}
+    for k in keys:
+        prog = programs.setdefault(k.partition(":")[0], {"combos": {}})
+        prog["combos"][k] = {"assignment": {},
+                             "coverage": "registry:" + k, "reason": ""}
+    path.write_text(json.dumps({"programs": programs}))
+
+
 def test_prune_drops_unserved_buckets_and_dead_census_rows(tmp_path):
     """tools/kubeaot --prune: serving rows whose pod bucket the flight
     recorder never saw are dead rungs (payload deleted, row dropped);
     census rows whose manifest row is gone (the census drift gate's
-    "removed" class) go the same way."""
+    "removed" class) go the same way; and — the proof join — census rows
+    whose rung the committed closure no longer proves reachable are dead
+    even while their manifest row lingers."""
     from tools.kubeaot.build import prune
     store = aot.AotStore(str(tmp_path))
     rows = []
@@ -345,7 +358,8 @@ def test_prune_drops_unserved_buckets_and_dead_census_rows(tmp_path):
             ("s8.aotx", "serving", 8, "serving:g@b8"),
             ("s64.aotx", "serving", 64, "serving:g@b64"),
             ("c1.aotx", "census", 8, "_schedule_gang@n8_b8"),
-            ("c2.aotx", "census", 8, "_schedule_gang@n_gone")):
+            ("c2.aotx", "census", 8, "_schedule_gang@n_gone"),
+            ("c3.aotx", "census", 8, "_schedule_gang:dead@n8_b8")):
         store.save(name, {}, b"payload", None, None)
         rows.append({"row": rid, "family": fam, "sig_key": name,
                      "artifact": name, "pod_bucket": bucket})
@@ -356,16 +370,40 @@ def test_prune_drops_unserved_buckets_and_dead_census_rows(tmp_path):
                          "meta": {"pod_bucket": 8}},
                         {"seq": 2, "label": "prewarm", "meta": {}}]}))
     manifest_rows = [{"program": "_schedule_gang", "tag": "",
+                      "variant": "n8_b8"},
+                     {"program": "_schedule_gang", "tag": "dead",
                       "variant": "n8_b8"}]
+    closure_path = tmp_path / "closure.json"
+    _write_closure(closure_path, ["_schedule_gang"])   # :dead unproved
     rep = prune(str(tmp_path), trace_path=str(trace_path),
-                manifest_rows=manifest_rows)
+                manifest_rows=manifest_rows,
+                closure_path=str(closure_path))
     assert rep["kept"] == 2
-    assert sorted(rep["dropped"]) == ["_schedule_gang@n_gone",
+    assert sorted(rep["dropped"]) == ["_schedule_gang:dead@n8_b8",
+                                      "_schedule_gang@n_gone",
                                       "serving:g@b64"]
+    assert rep["unproved"] == ["_schedule_gang:dead@n8_b8"]
     assert not os.path.exists(tmp_path / "s64.aotx")
+    assert not os.path.exists(tmp_path / "c3.aotx")
     assert os.path.exists(tmp_path / "s8.aotx")
     kept_rows = {r["row"] for r in store.read_index()["rows"]}
     assert kept_rows == {"serving:g@b8", "_schedule_gang@n8_b8"}
+
+
+def test_prune_without_closure_skips_proof_join(tmp_path):
+    """No committed closure = no proof information: prune must keep
+    census rows rather than treat every rung as unreachable."""
+    from tools.kubeaot.build import prune
+    store = aot.AotStore(str(tmp_path))
+    store.save("c1.aotx", {}, b"payload", None, None)
+    store.write_index(aot.env_signature(), [
+        {"row": "_schedule_gang@n8_b8", "family": "census",
+         "sig_key": "c1.aotx", "artifact": "c1.aotx", "pod_bucket": 8}])
+    rep = prune(str(tmp_path),
+                manifest_rows=[{"program": "_schedule_gang", "tag": "",
+                                "variant": "n8_b8"}],
+                closure_path=str(tmp_path / "absent.json"))
+    assert rep["kept"] == 1 and rep["unproved"] == []
 
 
 # ------------------------------------------------------------- CI gates
@@ -390,7 +428,10 @@ def test_check_index_passes_on_matching_keys(tmp_path):
     idx.write_text(json.dumps(
         {"rows": [{"row": rid, "family": "census"} for rid in ids]
          + [{"row": "serving:x@b8", "family": "serving"}]}))
-    assert check_index(str(idx), manifest_path=str(man)) == []
+    closure = tmp_path / "closure.json"
+    _write_closure(closure, ["_schedule_gang", "_schedule_sequential"])
+    assert check_index(str(idx), manifest_path=str(man),
+                       closure_path=str(closure)) == []
 
 
 def test_check_index_fails_both_directions(tmp_path):
@@ -402,11 +443,37 @@ def test_check_index_fails_both_directions(tmp_path):
     idx.write_text(json.dumps(
         {"rows": [{"row": "_schedule_gang@n8_b8", "family": "census"},
                   {"row": "_schedule_gang@n_stale", "family": "census"}]}))
-    failures = check_index(str(idx), manifest_path=str(man))
+    failures = check_index(str(idx), manifest_path=str(man),
+                           closure_path=str(tmp_path / "absent.json"))
     assert any("manifest row with no artifact: _schedule_gang@n64_b64"
                in f for f in failures)
     assert any("artifact with no manifest row: _schedule_gang@n_stale"
                in f for f in failures)
+
+
+def test_check_index_flags_prune_closure_disagreement(tmp_path):
+    """Both disagreement directions: an artifact rung outside the proved
+    closure (should have been pruned), and a closure-reachable rung of an
+    AOT program with no artifact (build lags the proof)."""
+    from tools.kubeaot.build import check_index
+    ids = ["_schedule_gang@n8_b8", "_schedule_gang:bias@n8_b8"]
+    man = tmp_path / "manifest.json"
+    _write_manifest(man, ids)
+    idx = tmp_path / "index.json"
+    idx.write_text(json.dumps(
+        {"rows": [{"row": rid, "family": "census"} for rid in ids]}))
+    closure = tmp_path / "closure.json"
+    # :bias artifact is unproved; :hostok is proved but has no artifact
+    _write_closure(closure, ["_schedule_gang", "_schedule_gang:hostok",
+                             "_apply_cluster_delta:donated"])  # not AOT
+    failures = check_index(str(idx), manifest_path=str(man),
+                           closure_path=str(closure))
+    assert any("outside the proved closure" in f
+               and "_schedule_gang:bias" in f for f in failures)
+    assert any("no artifact" in f and "_schedule_gang:hostok" in f
+               and "closure" in f for f in failures)
+    # non-AOT closure programs (delta appliers) never demand artifacts
+    assert not any("_apply_cluster_delta" in f for f in failures)
 
 
 def test_flush_index_replaces_stale_rows(tmp_path):
@@ -474,13 +541,17 @@ def test_cli_check_mode(tmp_path):
     idx = tmp_path / "index.json"
     idx.write_text(json.dumps(
         {"rows": [{"row": rid, "family": "census"} for rid in ids]}))
+    closure = tmp_path / "closure.json"
+    _write_closure(closure, ["_schedule_gang"])
     import tools.kubecensus.manifest as m
     old = m.MANIFEST_PATH
     m.MANIFEST_PATH = str(man)
     try:
-        assert main(["--check", "--index", str(idx), "--json"]) == 0
+        assert main(["--check", "--index", str(idx),
+                     "--closure", str(closure), "--json"]) == 0
         idx.write_text(json.dumps({"rows": []}))
-        assert main(["--check", "--index", str(idx), "--json"]) == 1
+        assert main(["--check", "--index", str(idx),
+                     "--closure", str(closure), "--json"]) == 1
     finally:
         m.MANIFEST_PATH = old
 

@@ -238,6 +238,45 @@ def test_match_compile_events_classification():
     assert len(rep["outside"]) == 1 and "float64" in rep["outside"][0]
 
 
+def test_match_compile_events_closure_membership():
+    """With a committed closure, proved programs classify by CLOSURE
+    MEMBERSHIP instead of the subsequence heuristic: committed leaf
+    (dtype, rank) structure plus bucket-sum-licensed dims (popcount <= 3,
+    covering a pow2 bucket or a concat of up to three) under the
+    north-star caps.  Off-ladder dims, dims past the caps, and novel
+    dtypes stay outside; programs the closure does not prove keep the
+    legacy structural path."""
+    rows = [_mk_row("prog", ["float32[8,4]", "bool[8]"]),
+            _mk_row("free", ["float32[8,4]", "bool[8]"])]
+    closure = {"programs": {"prog": {"combos": {}}}}
+    events = {
+        # closure: pow2 dim (1024 <= N-cap) at committed structure
+        ("prog", "[ShapedArray(float32[1024,4]), ShapedArray(bool[1024])]"): 1,
+        # closure: bucket sums — 3 = 1+2 (concat of two selector sets),
+        # 4097 = 4096+1 (spliced term-slot axis)
+        ("prog", "[ShapedArray(float32[4097,4]), ShapedArray(bool[3])]"): 1,
+        # outside: 15 = 1+2+4+8 needs FOUR buckets; no serving join
+        # concatenates more than three independently bucketed sets
+        ("prog", "[ShapedArray(float32[15,4]), ShapedArray(bool[15])]"): 1,
+        # outside: pow2 but past the north-star caps (2**21 > P = 2**17)
+        ("prog", "[ShapedArray(float32[2097152,4])]"): 1,
+        # outside for a CLOSED program: the heuristic would have accepted
+        # this subsequence, membership demands committed (dtype, rank)s
+        ("prog", "[ShapedArray(int32[8])]"): 1,
+        # unproved program: legacy structural subsequence still matches
+        ("free", "[ShapedArray(float32[64,4])]"): 1,
+    }
+    rep = match_compile_events(events, rows, closure=closure)
+    assert rep["matched_closure"] == 2
+    assert rep["matched_structural"] == 1
+    assert len(rep["outside"]) == 3, rep
+    # no closure = legacy everywhere: the pruning subsequence matches
+    rep = match_compile_events(
+        {("prog", "[ShapedArray(int32[8])]"): 1},
+        [_mk_row("prog", ["float32[8,4]", "int32[8]"])])
+    assert rep["matched_structural"] == 1 and rep["matched_closure"] == 0
+
+
 def test_real_dispatch_matches_committed_manifest():
     """Close the loop in-process: a REAL dispatch of a kernel root at a
     census rung produces a compile event that matches the committed

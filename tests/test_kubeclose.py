@@ -1,0 +1,439 @@
+"""kubeclose self-tests: every close/* rule fires on a known-bad snippet
+and stays quiet on the matching known-good one; the committed
+CLOSURE_MANIFEST.json regenerates byte-identically over the committed
+tree; drift is caught in both directions; the pure-JSON ``--check`` gate
+runs green without jax (enforced under an import blocker); stale
+exemptions fire; and — the serving-path loop — every seam signature a
+churned pipelined drain actually dispatches is a member of the committed
+closure.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools.kubeclose import closure as kc
+from tools.kubeclose import domains, manifest
+from tools.kubeclose.engine import ProvenanceEngine
+from tools.kubeclose import seams as seams_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+EMPTY_REGISTRY = "ENTRIES = []\n"
+
+
+def prove_snippet(tmp_path, src, registry_src=EMPTY_REGISTRY):
+    """Run the full prover pipeline over one snippet module with a
+    snippet registry (pure AST on both sides, like the real run)."""
+    from tools.kubelint.core import load_modules
+    os.makedirs(str(tmp_path), exist_ok=True)
+    f = tmp_path / "snippet.py"
+    f.write_text(src)
+    reg = tmp_path / "registry.py"
+    reg.write_text(registry_src)
+    modules = load_modules([str(f)], root=str(tmp_path))
+    engine = ProvenanceEngine(modules)
+    seam_list, orphans = seams_mod.collect(engine)
+    seam_list.sort(key=lambda s: s.program)
+    return kc.prove(seam_list, orphans, registry_path=str(reg))
+
+
+@pytest.fixture
+def bare_domains(monkeypatch):
+    """Snippet isolation: the in-tree EXTRA_ROOTS point at kubetpu
+    qualnames a snippet set cannot resolve, and the in-tree exemptions
+    would all report stale against a snippet's findings."""
+    monkeypatch.setattr(domains, "EXTRA_ROOTS", ())
+    monkeypatch.setattr(domains, "EXEMPTIONS", ())
+
+
+def rule_ids(res):
+    return sorted({f.rule for f in res.findings})
+
+
+# ------------------------------------------------------- per-rule snippets
+
+
+UNBOUNDED_BAD = """
+from kubetpu.utils import aot
+
+def _prog(x, mode):
+    return x
+
+def run(x, mode):
+    return aot.dispatch("_prog", _prog, (x, mode), dict(),
+                        static_argnums=(1,))
+"""
+
+UNBOUNDED_GOOD = """
+from kubetpu.utils import aot
+
+def _prog(x, mode):
+    return x
+
+def run(x, mode):
+    return aot.dispatch("_prog", _prog, (x, mode), dict(),
+                        static_argnums=(1,))
+
+def serve(x):
+    return run(x, "dense")
+"""
+
+
+def test_unbounded_static_fires_and_good_twin_is_quiet(tmp_path,
+                                                       bare_domains):
+    res = prove_snippet(tmp_path / "bad", UNBOUNDED_BAD)
+    assert "close/unbounded-static" in rule_ids(res)
+    res = prove_snippet(
+        tmp_path / "good", UNBOUNDED_GOOD,
+        'ENTRIES = [Entry("_prog", tag="dense",\n'
+        '                 closure_statics=(("mode", "\'dense\'"),))]\n')
+    assert res.findings == []
+    combos = res.programs[0].combos
+    # single call site, single literal: mode is a FIXED axis, one combo
+    assert res.programs[0].fixed == {"mode": "'dense'"}
+    assert len(combos) == 1 and combos[0].coverage == "registry:_prog:dense"
+
+
+UNBUCKETED_BAD = """
+from kubetpu.utils import aot
+
+def _prog(x, n: int):
+    return x
+
+def run(x, flag: bool):
+    return aot.dispatch("_prog", _prog, (x, flag), dict(),
+                        static_argnums=(1,))
+"""
+
+UNBUCKETED_GOOD = """
+from kubetpu.utils import aot
+from kubetpu.utils.intern import pow2_bucket
+
+def _prog(x, n: int):
+    return x
+
+def run(x, m):
+    return aot.dispatch("_prog", _prog, (x, pow2_bucket(m)), dict(),
+                        static_argnums=(1,))
+"""
+
+
+def test_unbucketed_shape_fires_and_pow2_twin_is_quiet(tmp_path,
+                                                       bare_domains):
+    res = prove_snippet(tmp_path / "bad", UNBUCKETED_BAD)
+    assert "close/unbucketed-shape" in rule_ids(res)
+    res = prove_snippet(tmp_path / "good", UNBUCKETED_GOOD,
+                        'ENTRIES = [Entry("_prog")]\n')
+    assert "close/unbucketed-shape" not in rule_ids(res)
+    assert res.findings == []
+    assert res.programs[0].symbolic == {"n": "pow2-bucketed"}
+
+
+CROSSED = """
+from kubetpu.utils import aot
+
+def _prog(x, flag):
+    return x
+
+def serve_on(x):
+    return _run(x, True)
+
+def serve_off(x):
+    return _run(x, False)
+
+def _run(x, flag):
+    return aot.dispatch("_prog", _prog, (x, flag), dict(),
+                        static_argnums=(1,))
+"""
+
+
+def test_uncaptured_signature_fires_per_uncovered_combo(tmp_path,
+                                                        bare_domains):
+    res = prove_snippet(
+        tmp_path, CROSSED,
+        'ENTRIES = [Entry("_prog", tag="on",\n'
+        '                 closure_statics=(("flag", "True"),))]\n')
+    assert rule_ids(res) == ["close/uncaptured-signature"]
+    assert [f.key for f in res.findings] == ["_prog flag=False"]
+    cov = {c.key: c.coverage for c in res.programs[0].combos}
+    assert cov == {"_prog flag=True": "registry:_prog:on",
+                   "_prog flag=False": ""}
+
+
+def test_unreachable_manifest_row_fires_on_dead_rung(tmp_path,
+                                                     bare_domains):
+    res = prove_snippet(
+        tmp_path, CROSSED,
+        'ENTRIES = [Entry("_prog", tag="on",\n'
+        '                 closure_statics=(("flag", "True"),)),\n'
+        '           Entry("_prog", tag="off",\n'
+        '                 closure_statics=(("flag", "False"),)),\n'
+        '           Entry("_prog", tag="dead",\n'
+        '                 closure_statics=(("flag", "\'maybe\'"),))]\n')
+    assert rule_ids(res) == ["close/unreachable-manifest-row"]
+    assert [f.key for f in res.findings] == ["_prog:dead"]
+
+
+def test_stale_exemption_fires(tmp_path, monkeypatch):
+    monkeypatch.setattr(domains, "EXTRA_ROOTS", ())
+    monkeypatch.setattr(domains, "EXEMPTIONS", (
+        ("close/uncaptured-signature", "_prog flag=False",
+         "falls back to the trace path"),
+        ("close/uncaptured-signature", "_prog flag='gone'",
+         "rung removed long ago"),
+    ))
+    res = prove_snippet(
+        tmp_path, CROSSED,
+        'ENTRIES = [Entry("_prog", tag="on",\n'
+        '                 closure_statics=(("flag", "True"),))]\n')
+    assert rule_ids(res) == ["close/stale-exemption"]
+    assert [f.key for f in res.findings] == [
+        "close/uncaptured-signature _prog flag='gone'"]
+    # the consumed exemption stamped its combo
+    cov = {c.key: (c.coverage, c.reason) for c in res.programs[0].combos}
+    assert cov["_prog flag=False"] == ("exempt",
+                                       "falls back to the trace path")
+
+
+PRESENCE = """
+from kubetpu.utils import aot
+
+def _prog(x, host_ok=None):
+    return x
+
+def serve(x):
+    return aot.dispatch("_prog", _prog, (x,), dict(host_ok=None))
+
+def serve_masked(x, mask):
+    return aot.dispatch("_prog", _prog, (x,), dict(host_ok=mask))
+"""
+
+
+def test_presence_axis_crosses_the_treedef(tmp_path, bare_domains):
+    """A None-default dynamic kwarg is a closure axis by PRESENCE: the
+    call treedef differs, so both sides need coverage."""
+    res = prove_snippet(
+        tmp_path, PRESENCE,
+        'ENTRIES = [Entry("_prog",\n'
+        '                 closure_statics=(("host_ok", "absent"),)),\n'
+        '           Entry("_prog", tag="hostok",\n'
+        '                 closure_statics=(("host_ok", "present"),))]\n')
+    assert res.findings == []
+    ax = res.programs[0].seam.axes["host_ok"]
+    assert ax.kind == "presence"
+
+
+# ------------------------------------- committed manifest: bytes and drift
+
+
+@pytest.fixture(scope="module")
+def proved():
+    """One full prover run over the committed tree, shared."""
+    return kc.run(REPO)
+
+
+def test_committed_manifest_regenerates_byte_identically(proved):
+    doc = manifest.build_manifest(proved)
+    blob = json.dumps(doc, indent=1, sort_keys=True) + "\n"
+    with open(manifest.MANIFEST_PATH, "rb") as f:
+        committed = f.read()
+    assert blob.encode() == committed, \
+        "CLOSURE_MANIFEST.json drifted — run: make close"
+    # determinism: a second build of the same result is the same bytes
+    assert json.dumps(manifest.build_manifest(proved), indent=1,
+                      sort_keys=True) + "\n" == blob
+
+
+def test_committed_closure_is_proved(proved):
+    assert proved.findings == []
+    doc = manifest.build_manifest(proved)
+    assert doc["counts"]["findings"] == 0
+    # the headline criterion: ZERO unbounded static positions
+    for program, prog in doc["programs"].items():
+        for axis, ax in prog["axes"].items():
+            assert ax["label"] != "unbounded", (program, axis)
+
+
+def test_drift_detected_in_both_directions(proved):
+    doc = manifest.build_manifest(proved)
+    committed = manifest.load_manifest()
+    assert committed is not None
+    assert manifest.diff_manifest(doc, committed) == {
+        "added": [], "removed": [], "changed": []}
+    # direction 1: the tree proves a program the file does not carry
+    shrunk = json.loads(json.dumps(committed))
+    gone = sorted(shrunk["programs"])[0]
+    del shrunk["programs"][gone]
+    d = manifest.diff_manifest(doc, shrunk)
+    assert d["added"] == [gone]
+    # direction 2: the file carries a program the tree no longer proves
+    grown = json.loads(json.dumps(committed))
+    grown["programs"]["_ghost"] = {"combos": {}}
+    d = manifest.diff_manifest(doc, grown)
+    assert d["removed"] == ["_ghost"]
+    # content drift under a shared key
+    mut = json.loads(json.dumps(committed))
+    prog = sorted(mut["programs"])[0]
+    mut["programs"][prog]["combos"]["_forged x=1"] = {
+        "assignment": {"x": "1"}, "coverage": "exempt", "reason": "r"}
+    d = manifest.diff_manifest(doc, mut)
+    assert d["changed"] == ["%s (combos)" % prog]
+
+
+# ----------------------------------------------------- the no-jax CI gate
+
+
+def test_committed_check_is_green():
+    assert manifest.check_manifest(manifest.load_manifest()) == []
+
+
+def test_check_fails_on_forged_coverage_and_unbounded(tmp_path):
+    doc = json.loads(json.dumps(manifest.load_manifest()))
+    prog = sorted(doc["programs"])[0]
+    doc["programs"][prog]["combos"]["forged"] = {
+        "assignment": {}, "coverage": "registry:_no_such:row",
+        "reason": ""}
+    doc["programs"][prog]["axes"]["bad"] = {
+        "kind": "static", "label": "unbounded", "values": None,
+        "why": "forged"}
+    fails = manifest.check_manifest(doc)
+    assert any("_no_such:row" in f for f in fails)
+    assert any("unbounded" in f for f in fails)
+    # an uncovered combo and a reasonless exemption both fail
+    doc["programs"][prog]["combos"]["forged"] = {
+        "assignment": {}, "coverage": "", "reason": ""}
+    assert any("neither registry-covered nor exempt" in f
+               for f in manifest.check_manifest(doc))
+    doc["programs"][prog]["combos"]["forged"] = {
+        "assignment": {}, "coverage": "exempt", "reason": ""}
+    assert any("without a reason" in f for f in manifest.check_manifest(doc))
+
+
+def test_check_runs_without_jax():
+    """ci_lint.sh runs ``--check`` before anything imports jax; an import
+    blocker proves the gate path never touches it."""
+    blocker = (
+        "import sys\n"
+        "class _NoJax:\n"
+        "    def find_spec(self, name, path=None, target=None):\n"
+        "        if name == 'jax' or name.startswith('jax.'):\n"
+        "            raise ImportError('jax is blocked in the --check "
+        "gate')\n"
+        "sys.meta_path.insert(0, _NoJax())\n"
+        "from tools.kubeclose.__main__ import main\n"
+        "sys.exit(main(['--check']))\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", blocker], cwd=REPO,
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "committed closure OK" in proc.stdout
+
+
+# -------------------------------------------------- serving-path e2e loop
+
+
+def test_drained_dispatch_signatures_are_closure_members(monkeypatch):
+    """Close the loop against the REAL serving path: churn a pipelined
+    gang drain, record every aot.dispatch seam call, and assert each
+    dispatched signature is a member of the committed closure — program
+    proved, every enumerated static on an enumerated axis value, every
+    crossed assignment an enumerated combo."""
+    import inspect
+
+    from kubetpu.apis.config import (KubeSchedulerConfiguration,
+                                     KubeSchedulerProfile)
+    from kubetpu.client.store import ClusterStore
+    from kubetpu.harness import hollow
+    from kubetpu.scheduler import Scheduler
+    from kubetpu.utils import aot
+
+    committed = manifest.load_manifest()
+    assert committed is not None
+    programs = committed["programs"]
+
+    recorded = []
+    real = aot.dispatch
+
+    def recording(program, jitfn, args, kwargs, static_argnums=(),
+                  static_argnames=()):
+        recorded.append((program, jitfn, args, dict(kwargs),
+                         tuple(static_argnums), tuple(static_argnames)))
+        return real(program, jitfn, args, kwargs,
+                    static_argnums=static_argnums,
+                    static_argnames=static_argnames)
+
+    monkeypatch.setattr(aot, "dispatch", recording)
+
+    store = ClusterStore()
+    for n in hollow.make_nodes(8, zones=4):
+        store.add(n)
+    sched = Scheduler(store, config=KubeSchedulerConfiguration(
+        profiles=[KubeSchedulerProfile()], batch_size=4, mode="gang",
+        chain_cycles=True, pipeline_cycles=True, pipeline_depth=2),
+        async_binding=False)
+    try:
+        # churn: two waves of different sizes so the drain crosses pod
+        # buckets mid-flight
+        for p in hollow.make_pods(12, group_labels=4):
+            store.add(p)
+        for _ in range(12):
+            if not sched.schedule_pending(timeout=1.0):
+                break
+        for p in hollow.make_pods(3, prefix="churn-", group_labels=2):
+            store.add(p)
+        for _ in range(12):
+            if not sched.schedule_pending(timeout=1.0):
+                break
+    finally:
+        sched.close()
+
+    assert recorded, "the drain dispatched no seamed programs"
+    checked = 0
+    for program, jitfn, args, kwargs, argnums, argnames in recorded:
+        assert program in programs, \
+            "dispatched program %r is outside the closure" % program
+    prog_doc = None
+    for program, jitfn, args, kwargs, argnums, argnames in recorded:
+        prog_doc = programs[program]
+        axes = prog_doc["axes"]
+        sig = inspect.signature(getattr(jitfn, "__wrapped__", jitfn))
+        params = list(sig.parameters)
+        statics = {}
+        for i in argnums:
+            if i < len(args):
+                statics[params[i]] = args[i]
+        for name in argnames:
+            if name in kwargs:
+                statics[name] = kwargs[name]
+            else:
+                dflt = sig.parameters[name].default
+                if dflt is not inspect.Parameter.empty:
+                    statics[name] = dflt
+        assignment = {}
+        for name, value in statics.items():
+            ax = axes.get(name)
+            assert ax is not None, (program, name)
+            if ax["values"] is None:
+                continue            # symbolic: finite by proof
+            assert repr(value) in ax["values"], \
+                "%s static %s=%r outside proved axis %s" \
+                % (program, name, value, ax["values"])
+            if len(ax["values"]) > 1:
+                assignment[name] = repr(value)
+        for name, ax in axes.items():
+            if ax["kind"] != "presence":
+                continue
+            state = ("present" if kwargs.get(name) is not None
+                     else "absent")
+            assert state in ax["values"], (program, name, state)
+            if len(ax["values"]) > 1:
+                assignment[name] = state
+        key = kc.combo_key(program, assignment)
+        assert key in prog_doc["combos"], \
+            "dispatched signature %r is not an enumerated combo" % key
+        checked += 1
+    assert checked == len(recorded)

@@ -65,6 +65,21 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m tools.kubecensus --check --json
 # manifest row, or a manifest row with no artifact at census rungs,
 # fails.  Regenerate after an intentional surface change: make aot.
 python -m tools.kubeaot --check --json
+# Compile-surface closure gate, pure-JSON half (tools/kubeclose --check,
+# no jax): the committed CLOSURE_MANIFEST.json must carry zero findings
+# and zero unbounded axes, pin the northstar environment byte-equal to
+# tools/kubeexact/northstar.py, resolve every registry coverage pointer
+# to a COMPILE_MANIFEST.json row, give every exempt combo a reason
+# naming its fallback path, and cover every AOT_INDEX.json program.
+python -m tools.kubeclose --check --json
+# Compile-surface closure, full prover (still no jax — pure AST over
+# kubetpu/): re-proves the closure interprocedurally, enumerates every
+# reachable dispatch signature at the committed north-star environment,
+# and fails on any close/* finding (unbounded-static, unbucketed-shape,
+# uncaptured-signature, unreachable-manifest-row, stale-exemption) or
+# DRIFT against the committed CLOSURE_MANIFEST.json in either direction.
+# Regenerate after an intentional seam change: make close.
+python -m tools.kubeclose --json
 # Exactness manifest gate, pure-JSON half (tools/kubeexact --check, no
 # jax): the committed EXACT_MANIFEST.json must pin the northstar
 # environment and constants, keep every proof exact/exempt with margin
@@ -146,6 +161,14 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m tools.kubeexact --json
 # directions, and exemption staleness is audited.
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
 	tests/test_kubeexact.py -q -m 'not slow' -p no:cacheprovider
+# Closure prover suite: every close/* rule fires on a seeded bad snippet
+# and stays quiet on the good twin, the committed CLOSURE_MANIFEST.json
+# regenerates byte-identically, drift is seen in both directions, the
+# --check gate runs under a jax import blocker, stale exemptions fire,
+# and a churned pipelined drain's dispatched seam signatures are all
+# members of the committed closure.
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
+	tests/test_kubeclose.py -q -m 'not slow' -p no:cacheprovider
 # Bench-trend CI check (tools/benchtrend.py, pure JSON, no jax): the
 # committed BENCH_r*/MULTICHIP_r* trajectory must stay schema-compatible
 # with the trend tooling, and the newest parseable round must not

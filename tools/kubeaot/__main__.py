@@ -8,14 +8,22 @@
               mismatch vs the manifest (the bit-identity oracle)
 --check       (default) pure-JSON CI gate: committed AOT_INDEX.json and
               COMPILE_MANIFEST.json must share the same census-family
-              row keys in both directions — no jax, safe in ci_lint.sh
+              row keys in both directions, and the index must agree
+              with the committed compile-surface closure
+              (CLOSURE_MANIFEST.json) — an artifact rung the closure
+              proves unreachable, or a closure-reachable rung with no
+              artifact, is flagged as a prune/closure disagreement.
+              No jax, safe in ci_lint.sh
 --shape NxB   deploy-shaped capture: run Scheduler.prewarm at N nodes /
               B-pod waves under a capture runtime (what bench.py's
               aot-artifact restart mode builds from); --ladder K chains
               K dry-run rungs
 --prune       drop serving rows whose pod bucket the flight recorder
-              never saw (--trace PIPELINE_TRACE.json) and census rows
-              the manifest no longer carries
+              never saw (--trace PIPELINE_TRACE.json), census rows the
+              manifest no longer carries, and census rows whose rung
+              the committed closure proves unreachable (proof-driven:
+              observation says what WAS served, the closure says what
+              CAN be dispatched)
 --json        machine-readable report on stdout
 """
 
@@ -43,6 +51,8 @@ def main(argv=None) -> int:
                     help="artifact directory (default artifacts/aot)")
     ap.add_argument("--index", default=None,
                     help="committed index path override (tests)")
+    ap.add_argument("--closure", default=None,
+                    help="CLOSURE_MANIFEST.json path override (tests)")
     ap.add_argument("--trace", default=None,
                     help="flight-recorder export for --prune bucket data")
     ap.add_argument("--ladder", type=int, default=2,
@@ -71,11 +81,14 @@ def main(argv=None) -> int:
         ok = rep.get("rows", 0) > 0
         doc = {"op": "shape", **rep, "clean": ok}
     elif args.prune:
-        rep = b.prune(out_dir, trace_path=args.trace)
+        rep = b.prune(out_dir, trace_path=args.trace,
+                      closure_path=args.closure or b.CLOSURE_PATH)
         ok = "error" not in rep
         doc = {"op": "prune", "out": out_dir, **rep, "clean": ok}
     else:
-        failures = b.check_index(args.index or b.INDEX_COMMIT_PATH)
+        failures = b.check_index(args.index or b.INDEX_COMMIT_PATH,
+                                 closure_path=args.closure
+                                 or b.CLOSURE_PATH)
         ok = not failures
         doc = {"op": "check", "failures": failures, "clean": ok}
 

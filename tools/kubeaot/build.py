@@ -18,13 +18,21 @@ AotStore layout — ``*.aotx`` payloads + ``index.json``):
   "serving") with byte-identical call forms to a real restart of that
   shape, which is what makes the serve-time signature lookup hit.
 * ``prune``: drop ladder buckets the flight recorder never saw serve
-  (the exported trace's per-cycle ``pod_bucket`` meta) and census rows
+  (the exported trace's per-cycle ``pod_bucket`` meta), census rows
   whose manifest row no longer exists (census "removed" drift = dead
-  rung).  Artifacts are deleted, the index rewritten.
+  rung), and — the proof join — census rows whose registry rung the
+  committed compile-surface closure (CLOSURE_MANIFEST.json,
+  tools/kubeclose) no longer proves reachable: observation says what WAS
+  served, the closure says what CAN be dispatched, and an artifact
+  outside both is dead weight.  Artifacts are deleted, the index
+  rewritten.
 * ``check_index``: the pure-JSON CI gate — the committed AOT_INDEX.json
   census rows and COMPILE_MANIFEST.json must share the same row keys in
   both directions (an artifact with no manifest row, or a manifest row
-  with no artifact at census rungs, fails).  Runs without jax.
+  with no artifact at census rungs, fails), and the index must agree
+  with the committed closure (an artifact rung the closure proves
+  unreachable, or a closure-reachable rung with no artifact, is a
+  prune/closure disagreement).  Runs without jax.
 """
 
 from __future__ import annotations
@@ -84,6 +92,29 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(
 DEFAULT_OUT = os.path.join(_REPO_ROOT, "artifacts", "aot")
 INDEX_COMMIT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "AOT_INDEX.json")
+CLOSURE_PATH = os.path.join(_REPO_ROOT, "CLOSURE_MANIFEST.json")
+
+
+def closure_reachable_keys(closure_path: str = CLOSURE_PATH
+                           ) -> Optional[Set[str]]:
+    """Registry entry keys ("program" or "program:tag") the committed
+    compile-surface closure proves reachable: the union of
+    ``registry:<key>`` coverage pointers over every enumerated combo of
+    CLOSURE_MANIFEST.json.  None when no closure is committed or the
+    file is unreadable — prune/check then skip the proof join instead of
+    treating every rung as dead."""
+    try:
+        with open(closure_path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    keys: Set[str] = set()
+    for prog in (doc.get("programs") or {}).values():
+        for combo in (prog.get("combos") or {}).values():
+            cov = combo.get("coverage") or ""
+            if cov.startswith("registry:"):
+                keys.add(cov.split(":", 1)[1])
+    return keys
 
 
 def aot_manifest_ids(rows: Optional[List[dict]]) -> Optional[Set[str]]:
@@ -210,10 +241,16 @@ def trace_buckets(doc: dict) -> Set[int]:
 
 
 def prune(out_dir: str, trace_path: Optional[str] = None,
-          manifest_rows: Optional[List[dict]] = None) -> dict:
+          manifest_rows: Optional[List[dict]] = None,
+          closure_path: str = CLOSURE_PATH) -> dict:
     """Drop dead artifacts: serving rows whose pod bucket the recorder
-    never saw (no trace data = no serving-row pruning), and census rows
-    whose manifest row is gone (the census drift gate's "removed" class).
+    never saw (no trace data = no serving-row pruning), census rows
+    whose manifest row is gone (the census drift gate's "removed"
+    class), and census rows whose registry rung falls outside the
+    committed compile-surface closure — proof-driven pruning: the
+    closure enumerates every signature the serving seams can reach, so
+    an artifact for a rung no enumerated combo covers can never be
+    dispatched and is deleted even while its manifest row lingers.
     Deletes the ``.aotx`` payloads and rewrites the index in place."""
     from kubetpu.utils.aot import AotStore
     from tools.kubecensus.manifest import load_manifest
@@ -228,29 +265,40 @@ def prune(out_dir: str, trace_path: Optional[str] = None,
             buckets = trace_buckets(json.load(f))
     ids = aot_manifest_ids(load_manifest() if manifest_rows is None
                            else manifest_rows)
-    kept, dropped = [], []
+    reach = closure_reachable_keys(closure_path)
+    kept, dropped, unproved = [], [], []
     for r in doc.get("rows", []):
         fam = r.get("family")
+        rid = r.get("row") or ""
         dead = (fam == "serving" and buckets and r.get("pod_bucket")
                 and int(r["pod_bucket"]) not in buckets)
         dead = dead or (fam == "census" and ids is not None
-                        and r.get("row") not in ids)
+                        and rid not in ids)
+        if (not dead and fam == "census" and reach is not None
+                and rid.partition("@")[0] not in reach):
+            unproved.append(rid)
+            dead = True
         if dead:
-            dropped.append(r.get("row"))
+            dropped.append(rid)
             if r.get("artifact"):
                 store.remove(r["artifact"])
         else:
             kept.append(r)
     store.write_index(doc.get("env") or {}, kept)
     return {"kept": len(kept), "dropped": sorted(dropped),
-            "buckets": sorted(buckets)}
+            "unproved": sorted(unproved), "buckets": sorted(buckets)}
 
 
 def check_index(index_path: str = INDEX_COMMIT_PATH,
-                manifest_path: Optional[str] = None) -> List[str]:
+                manifest_path: Optional[str] = None,
+                closure_path: str = CLOSURE_PATH) -> List[str]:
     """The CI gate (pure JSON, no jax): committed-index census rows and
     COMPILE_MANIFEST.json must share the same row keys for the seamed
-    programs at census rungs, in both directions.  Returns the failure
+    programs at census rungs, in both directions — and the index must
+    agree with the committed compile-surface closure: an artifact rung
+    the closure proves unreachable should have been pruned, and a
+    closure-reachable rung of an AOT program with no artifact means the
+    prune/build pipeline and the proof disagree.  Returns the failure
     list (empty = pass)."""
     from tools.kubecensus.manifest import load_manifest
 
@@ -271,4 +319,17 @@ def check_index(index_path: str = INDEX_COMMIT_PATH,
         failures.append("manifest row with no artifact: %s" % rid)
     for rid in sorted(have - want):
         failures.append("artifact with no manifest row: %s" % rid)
+    reach = closure_reachable_keys(closure_path)
+    if reach is not None:
+        have_keys = {rid.partition("@")[0] for rid in have if rid}
+        for k in sorted(have_keys - reach):
+            failures.append("artifact rung outside the proved closure "
+                            "(prune/closure disagreement — run: python "
+                            "-m tools.kubeaot --prune): %s" % k)
+        aotable = {k for k in reach
+                   if k.partition(":")[0] in AOT_PROGRAMS}
+        for k in sorted(aotable - have_keys):
+            failures.append("closure-reachable rung with no artifact "
+                            "(prune/closure disagreement — run: make "
+                            "aot): %s" % k)
     return failures

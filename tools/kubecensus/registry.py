@@ -206,6 +206,17 @@ class Entry:
     # prover generalize a grid-axis fold count from the probe rung to the
     # north-star environment (e.g. ("", "WB", "NT")).
     exact_grid_syms: Tuple[str, ...] = ()
+    # ---- closure prover metadata (tools/kubeclose) ---------------------
+    # The (axis, value) assignment this entry covers in the program's
+    # enumerated reachable-signature set: one pair per MULTI-VALUED
+    # closure axis (enumerated statics as canonical reprs — "'lax'",
+    # "True" — and optional-dynamic presence axes as "absent"/"present").
+    # kubeclose joins CLOSURE_MANIFEST combos against these, so a combo
+    # no entry matches is close/uncaptured-signature and an entry whose
+    # assignment matches no reachable combo is close/unreachable-
+    # manifest-row.  Single-valued and symbolic axes (cfg, mesh_key, the
+    # pad ladders) are carried by the manifest itself, not repeated here.
+    closure_statics: Tuple[Tuple[str, str], ...] = ()
 
     @property
     def key(self) -> str:
@@ -334,6 +345,27 @@ def _schedule_gang_bias(w):
     from kubetpu.models import gang
     return (gang._schedule_gang, (w.cluster, w.batch, w.cfg, w.rng),
             {"host_ok": w.host_ok(), "score_bias": w.score_bias()})
+
+
+def _schedule_gang_notopo(w):
+    from kubetpu.models import gang
+    # the term-free DEFAULT-BACKEND serving form: a batch with no
+    # topology terms routes intra_batch_topology=False (scheduler's
+    # needs_topo gate) while kernel_backend stays "lax" — a DISTINCT
+    # static combination from the plain entry (intra=True) that the
+    # closure prover found reachable-but-uncovered: the first term-free
+    # cycle of a default-config deployment compiled cold on the serving
+    # path
+    return (gang._schedule_gang, (w.cluster, w.batch, w.cfg, w.rng),
+            {"intra_batch_topology": False, "kernel_backend": "lax"})
+
+
+def _schedule_gang_notopo_hostok(w):
+    from kubetpu.models import gang
+    # host-filter cycles over a term-free batch on the lax backend
+    return (gang._schedule_gang, (w.cluster, w.batch, w.cfg, w.rng),
+            {"host_ok": w.host_ok(), "intra_batch_topology": False,
+             "kernel_backend": "lax"})
 
 
 def _schedule_gang_pallas(w):
@@ -561,9 +593,11 @@ ENTRIES: List[Entry] = [
     Entry("explain_filters", "kubetpu.models.programs:explain_filters",
           _explain_filters, static_argnums=(2,)),
     Entry("_explain_verdicts", "kubetpu.models.programs:_explain_verdicts",
-          _explain_verdicts, static_argnums=(2,)),
+          _explain_verdicts, static_argnums=(2,),
+          closure_statics=(("host_ok", "absent"),)),
     Entry("_explain_verdicts", "kubetpu.models.programs:_explain_verdicts",
-          _explain_verdicts_hostok, tag="hostok", static_argnums=(2,)),
+          _explain_verdicts_hostok, tag="hostok", static_argnums=(2,),
+          closure_statics=(("host_ok", "present"),)),
     Entry("filter_verdicts", "kubetpu.models.programs:filter_verdicts",
           _filter_verdicts, static_argnums=(2,)),
     Entry("whatif_static_ok", "kubetpu.models.programs:whatif_static_ok",
@@ -579,28 +613,67 @@ ENTRIES: List[Entry] = [
           "kubetpu.models.programs:nominated_topology_mask",
           _nominated_topology_mask, static_argnums=(5,)),
     Entry("_schedule_gang", "kubetpu.models.gang:_schedule_gang",
-          _schedule_gang, meshable=True, static_argnums=(2,)),
+          _schedule_gang, meshable=True, static_argnums=(2,),
+          closure_statics=(("host_ok", "absent"),
+                           ("intra_batch_topology", "True"),
+                           ("kernel_backend", "'lax'"),
+                           ("score_bias", "absent"))),
     Entry("_schedule_gang", "kubetpu.models.gang:_schedule_gang",
-          _schedule_gang_hostok, tag="hostok", static_argnums=(2,)),
+          _schedule_gang_hostok, tag="hostok", static_argnums=(2,),
+          closure_statics=(("host_ok", "present"),
+                           ("intra_batch_topology", "True"),
+                           ("kernel_backend", "'lax'"),
+                           ("score_bias", "absent"))),
     Entry("_schedule_gang", "kubetpu.models.gang:_schedule_gang",
-          _schedule_gang_bias, tag="bias", static_argnums=(2,)),
+          _schedule_gang_bias, tag="bias", static_argnums=(2,),
+          closure_statics=(("host_ok", "present"),
+                           ("intra_batch_topology", "True"),
+                           ("kernel_backend", "'lax'"),
+                           ("score_bias", "present"))),
+    Entry("_schedule_gang", "kubetpu.models.gang:_schedule_gang",
+          _schedule_gang_notopo, tag="notopo", static_argnums=(2,),
+          static_argnames=("intra_batch_topology", "kernel_backend"),
+          closure_statics=(("host_ok", "absent"),
+                           ("intra_batch_topology", "False"),
+                           ("kernel_backend", "'lax'"),
+                           ("score_bias", "absent"))),
+    Entry("_schedule_gang", "kubetpu.models.gang:_schedule_gang",
+          _schedule_gang_notopo_hostok, tag="notopo_hostok",
+          static_argnums=(2,),
+          static_argnames=("intra_batch_topology", "kernel_backend"),
+          closure_statics=(("host_ok", "present"),
+                           ("intra_batch_topology", "False"),
+                           ("kernel_backend", "'lax'"),
+                           ("score_bias", "absent"))),
     Entry("_schedule_gang", "kubetpu.models.gang:_schedule_gang",
           _schedule_gang_pallas, tag="pallas", static_argnums=(2,),
           static_argnames=("intra_batch_topology", "kernel_backend"),
           exact=True, exact_facts=(("zone_hot", "onehot_rows"),),
-          exact_grid_syms=("", "WB", "NT")),
+          exact_grid_syms=("", "WB", "NT"),
+          closure_statics=(("host_ok", "absent"),
+                           ("intra_batch_topology", "False"),
+                           ("kernel_backend", "'pallas'"),
+                           ("score_bias", "absent"))),
     Entry("_schedule_gang", "kubetpu.models.gang:_schedule_gang",
           _schedule_gang_pallas_hostok, tag="pallas_hostok",
           static_argnums=(2,),
           static_argnames=("intra_batch_topology", "kernel_backend"),
           exact=True, exact_facts=(("zone_hot", "onehot_rows"),),
-          exact_grid_syms=("", "WB", "NT")),
+          exact_grid_syms=("", "WB", "NT"),
+          closure_statics=(("host_ok", "present"),
+                           ("intra_batch_topology", "False"),
+                           ("kernel_backend", "'pallas'"),
+                           ("score_bias", "absent"))),
     Entry("_schedule_sequential",
           "kubetpu.models.sequential:_schedule_sequential",
-          _schedule_sequential, meshable=True, static_argnums=(2,)),
+          _schedule_sequential, meshable=True, static_argnums=(2,),
+          closure_statics=(("host_ok", "absent"),
+                           ("score_bias", "absent"))),
     Entry("_schedule_sequential",
           "kubetpu.models.sequential:_schedule_sequential",
-          _schedule_sequential_hostok, tag="hostok", static_argnums=(2,)),
+          _schedule_sequential_hostok, tag="hostok", static_argnums=(2,),
+          closure_statics=(("host_ok", "present"),
+                           ("score_bias", "absent"))),
     Entry("_materialize_assigned",
           "kubetpu.models.gang:_materialize_assigned",
           _materialize_assigned,
@@ -610,6 +683,7 @@ ENTRIES: List[Entry] = [
           "kubetpu.models.programs:_apply_cluster_delta",
           _apply_delta_donated, tag="donated", donate_argnums=(0,),
           static_argnames=(),
+          closure_statics=(("donate", "True"),),
           exempt=(("census/donation-unconsumed",
                    "by design: the four vocab-side tables (image_size/"
                    "image_spread/taint_is_hard/taint_is_prefer) are "
@@ -619,7 +693,8 @@ ENTRIES: List[Entry] = [
                    "alias (50/54)"),)),
     Entry("_apply_cluster_delta",
           "kubetpu.models.programs:_apply_cluster_delta",
-          _apply_delta_shared, tag="shared", static_argnames=()),
+          _apply_delta_shared, tag="shared", static_argnames=(),
+          closure_statics=(("donate", "False"),)),
     Entry("_densify_ids", "kubetpu.state.tensors:_densify_ids",
           _densify_kv, tag="kv", static_argnames=("L",)),
     Entry("_densify_ids", "kubetpu.state.tensors:_densify_ids",
@@ -634,7 +709,11 @@ ENTRIES: List[Entry] = [
           keep_sharding=True, static_argnums=(2,),
           static_argnames=("mesh_key", "intra_batch_topology",
                            "residual_window", "surface"),
-          exact=True),
+          exact=True,
+          closure_statics=(("host_ok", "absent"),
+                           ("intra_batch_topology", "True"),
+                           ("score_bias", "absent"),
+                           ("surface", "'replicated'"))),
     Entry("_shardmap_gang", "kubetpu.parallel.shardmap:_shardmap_gang",
           _shardmap_gang_tiled, tag="tiled", keep_sharding=True,
           static_argnums=(2,),
@@ -644,15 +723,22 @@ ENTRIES: List[Entry] = [
           # SnapshotBuilder writes zone_hot as a one-hot zone-membership
           # row per node (state/tensors.py); the zone-count psum's 2**24
           # proof rests on this row-sum-==-1 invariant
-          exact_facts=(("zone_hot", "onehot_rows"),)),
+          exact_facts=(("zone_hot", "onehot_rows"),),
+          closure_statics=(("host_ok", "absent"),
+                           ("intra_batch_topology", "False"),
+                           ("score_bias", "absent"),
+                           ("surface", "'tiled'"))),
     Entry("_shardmap_sequential",
           "kubetpu.parallel.shardmap:_shardmap_sequential",
           _shardmap_sequential, keep_sharding=True, static_argnums=(2,),
-          static_argnames=("mesh_key",), exact=True),
+          static_argnames=("mesh_key",), exact=True,
+          closure_statics=(("host_ok", "absent"),
+                           ("score_bias", "absent"))),
     Entry("_apply_delta_body",
           "kubetpu.parallel.shardmap:_apply_delta_body",
           _shardmap_delta_donated, tag="donated", donate_argnums=(0,),
           keep_sharding=True, static_argnames=("mesh_key",),
+          closure_statics=(("donate", "True"),),
           exempt=(("census/donation-unconsumed",
                    "by design, the shard_map twin of the gspmd scatter's "
                    "audited case: the four vocab-side tables are REPLACED "
@@ -665,7 +751,8 @@ ENTRIES: List[Entry] = [
     Entry("_apply_delta_body",
           "kubetpu.parallel.shardmap:_apply_delta_body",
           _shardmap_delta_shared, tag="shared", keep_sharding=True,
-          static_argnames=("mesh_key",), exact=True),
+          static_argnames=("mesh_key",), exact=True,
+          closure_statics=(("donate", "False"),)),
 ]
 
 

@@ -1,0 +1,94 @@
+"""CLI: ``python -m tools.kubeclose``.
+
+Default: re-prove the closure over the tree (pure AST — still no jax),
+print findings, and fail on drift against the committed
+CLOSURE_MANIFEST.json in either direction.  ``--write`` regenerates the
+committed file (byte-identical over an unchanged tree); ``--check``
+re-validates the committed JSON alone without parsing kubetpu.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="kubeclose",
+        description="interprocedural compile-surface closure prover")
+    ap.add_argument("--root", default=None,
+                    help="repository root (default: auto-detected)")
+    ap.add_argument("--write", action="store_true",
+                    help="regenerate CLOSURE_MANIFEST.json")
+    ap.add_argument("--check", action="store_true",
+                    help="pure-JSON validation of the committed manifest "
+                         "(no kubetpu parse)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output for CI")
+    args = ap.parse_args(argv)
+
+    from . import closure, manifest
+
+    if args.check:
+        fails = manifest.check_manifest(manifest.load_manifest())
+        if args.json:
+            print(json.dumps({"failures": fails}, indent=1,
+                             sort_keys=True))
+        else:
+            for f in fails:
+                print("close: FAIL %s" % f)
+            if not fails:
+                print("kubeclose --check: committed closure OK")
+        return 1 if fails else 0
+
+    res = closure.run(args.root or closure.REPO_ROOT)
+    doc = manifest.build_manifest(res)
+
+    if args.write:
+        path = manifest.write_manifest(doc)
+        print("wrote %s (%d programs, %d combos, %d covered, %d exempt, "
+              "%d findings)"
+              % (path, doc["counts"]["programs"], doc["counts"]["combos"],
+                 doc["counts"]["covered"], doc["counts"]["exempt"],
+                 doc["counts"]["findings"]))
+        return 1 if res.findings else 0
+
+    drift = manifest.diff_manifest(doc, manifest.load_manifest())
+    drifted = bool(drift.get("added") or drift.get("removed")
+                   or drift.get("changed")
+                   or drift.get("missing_manifest"))
+    if args.json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in res.findings],
+            "exemptions": [f.to_json() for f in res.exempted],
+            "counts": doc["counts"],
+            "drift": drift,
+        }, indent=1, sort_keys=True))
+        return 1 if (res.findings or drifted) else 0
+
+    for f in res.findings:
+        print("%s: %s\n    %s" % (f.rule, f.key, f.message))
+    print("kubeclose: %d program(s), %d combo(s) (%d registry-covered, "
+          "%d exempt), %d finding(s), %d exemption(s) consumed"
+          % (doc["counts"]["programs"], doc["counts"]["combos"],
+             doc["counts"]["covered"], doc["counts"]["exempt"],
+             len(res.findings), len(res.exempted)))
+    if drift.get("missing_manifest"):
+        print("close: DRIFT no committed CLOSURE_MANIFEST.json — run "
+              "make close")
+    for k in drift.get("added", []):
+        print("close: DRIFT program %s proved but not committed — run "
+              "make close" % k)
+    for k in drift.get("removed", []):
+        print("close: DRIFT committed program %s no longer proved — run "
+              "make close" % k)
+    for k in drift.get("changed", []):
+        print("close: DRIFT %s changed vs committed manifest — run "
+              "make close" % k)
+    return 1 if (res.findings or drifted) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

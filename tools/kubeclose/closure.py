@@ -1,0 +1,243 @@
+"""The closure proof: enumerate every seam's reachable signature set at
+the committed north-star environment, join it against the kubecensus
+registry's ``closure_statics`` coverage metadata, and emit ``close/*``
+findings for whatever falls outside.
+
+The registry is read by AST (tools/kubecensus/registry.py imports jax
+transitively; this package never does): ``Entry(...)`` rows of the
+``ENTRIES`` list yield (program, tag, closure_statics).  Matching is
+exact equality on the combo's CROSS axes — an entry must pin every
+multi-valued axis of its program; single-valued axes are fixed by the
+proof itself and symbolic axes (cfg, mesh keys, pad ladders) are finite
+by construction, so neither splits the combo space.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from itertools import product
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import domains
+from .seams import Seam, SeamProblem
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+REGISTRY_PATH = os.path.join(REPO_ROOT, "tools", "kubecensus",
+                             "registry.py")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    key: str
+    message: str
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "key": self.key,
+                "message": self.message}
+
+
+@dataclasses.dataclass
+class Combo:
+    key: str
+    assignment: Dict[str, str]          # cross axis -> value
+    coverage: str                       # "registry:<key>" | "exempt" | ""
+    reason: str = ""
+
+    def to_json(self) -> dict:
+        return {"assignment": self.assignment, "coverage": self.coverage,
+                "reason": self.reason}
+
+
+@dataclasses.dataclass
+class ProgramClosure:
+    seam: Seam
+    fixed: Dict[str, str]
+    symbolic: Dict[str, str]
+    combos: List[Combo]
+
+
+@dataclasses.dataclass
+class ClosureResult:
+    programs: List[ProgramClosure]
+    findings: List[Finding]             # unexempted
+    exempted: List[Finding]             # carried by domains.EXEMPTIONS
+    orphans: List[SeamProblem]
+
+
+# -------------------------------------------------- registry (AST, no jax)
+
+def registry_entries(path: str = REGISTRY_PATH
+                     ) -> List[Tuple[str, str, Dict[str, str]]]:
+    """(program, tag, closure_statics dict) for every ENTRIES row."""
+    with open(path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    rows: List[Tuple[str, str, Dict[str, str]]] = []
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, ast.AnnAssign):
+            targets = [stmt.target]
+        else:
+            continue
+        if not (any(isinstance(t, ast.Name) and t.id == "ENTRIES"
+                    for t in targets)
+                and isinstance(stmt.value, ast.List)):
+            continue
+        for el in stmt.value.elts:
+            if not (isinstance(el, ast.Call)
+                    and isinstance(el.func, ast.Name)
+                    and el.func.id == "Entry" and el.args
+                    and isinstance(el.args[0], ast.Constant)):
+                continue
+            program = el.args[0].value
+            tag = ""
+            statics: Dict[str, str] = {}
+            for kw in el.keywords:
+                if kw.arg == "tag" and isinstance(kw.value, ast.Constant):
+                    tag = kw.value.value
+                elif kw.arg == "closure_statics" and isinstance(
+                        kw.value, (ast.Tuple, ast.List)):
+                    for pair in kw.value.elts:
+                        if (isinstance(pair, (ast.Tuple, ast.List))
+                                and len(pair.elts) == 2
+                                and all(isinstance(p, ast.Constant)
+                                        for p in pair.elts)):
+                            statics[pair.elts[0].value] = pair.elts[1].value
+            rows.append((program, tag, statics))
+    return rows
+
+
+def entry_key(program: str, tag: str) -> str:
+    return program + (":" + tag if tag else "")
+
+
+# ------------------------------------------------------------ enumeration
+
+def combo_key(program: str, assignment: Dict[str, str]) -> str:
+    parts = ["%s=%s" % (a, assignment[a]) for a in sorted(assignment)]
+    return " ".join([program] + parts)
+
+
+def enumerate_program(seam: Seam) -> ProgramClosure:
+    fixed: Dict[str, str] = {}
+    symbolic: Dict[str, str] = {}
+    cross: List[Tuple[str, Tuple[str, ...]]] = []
+    for name in sorted(seam.axes):
+        ax = seam.axes[name]
+        if ax.values is None:
+            symbolic[name] = ax.label
+        elif len(ax.values) <= 1:
+            fixed[name] = ax.values[0] if ax.values else "<none>"
+        else:
+            cross.append((name, ax.values))
+    combos: List[Combo] = []
+    names = [n for n, _ in cross]
+    for values in product(*(v for _, v in cross)):
+        assignment = dict(zip(names, values))
+        combos.append(Combo(combo_key(seam.program, assignment),
+                            assignment, ""))
+    return ProgramClosure(seam, fixed, symbolic, combos)
+
+
+# --------------------------------------------------------------- coverage
+
+def prove(seams: Sequence[Seam], orphans: Sequence[SeamProblem],
+          registry_path: str = REGISTRY_PATH) -> ClosureResult:
+    entries = registry_entries(registry_path)
+    programs = [enumerate_program(s) for s in seams]
+    raw: List[Finding] = []
+    for s in seams:
+        for pr in s.problems:
+            raw.append(Finding(pr.rule, pr.key, pr.detail))
+    for pr in orphans:
+        raw.append(Finding(pr.rule, pr.key, pr.detail))
+
+    closure_programs = {p.seam.program for p in programs}
+    matched_entries = set()
+    for pc in programs:
+        own = [(prog, tag, st) for prog, tag, st in entries
+               if prog == pc.seam.program]
+        for combo in pc.combos:
+            # an entry covers a combo iff it pins every CROSS axis with
+            # the combo's value AND every axis the entry names agrees
+            # with the combo's full (fixed + crossed) assignment — a rung
+            # pinning a value the proof fixed differently is not coverage
+            full = dict(pc.fixed)
+            full.update(combo.assignment)
+            hit = None
+            for prog, tag, st in own:
+                if (all(a in st and st[a] == v
+                        for a, v in combo.assignment.items())
+                        and all(full.get(a) == v
+                                for a, v in st.items())):
+                    hit = (prog, tag)
+                    break
+            if hit is not None:
+                combo.coverage = "registry:" + entry_key(*hit)
+                matched_entries.add(hit)
+            else:
+                raw.append(Finding(
+                    "close/uncaptured-signature", combo.key,
+                    "reachable signature of %s has no registry row: a "
+                    "cold-start compile stall unless a fallback path is "
+                    "exempted" % pc.seam.program))
+    # after EVERY seam of every program has matched (a program can have
+    # several seams): a registry rung of a proved program that no
+    # enumerated combo selected is dead
+    for prog, tag, st in entries:
+        if (prog in closure_programs and st
+                and (prog, tag) not in matched_entries):
+            raw.append(Finding(
+                "close/unreachable-manifest-row",
+                entry_key(prog, tag),
+                "registry entry %s matches no enumerated reachable "
+                "signature of %s — a dead ladder rung"
+                % (entry_key(prog, tag), prog)))
+
+    exmap = {(rule, key): reason
+             for rule, key, reason in domains.EXEMPTIONS}
+    consumed = set()
+    findings: List[Finding] = []
+    exempted: List[Finding] = []
+    for f in raw:
+        reason = exmap.get((f.rule, f.key))
+        if reason is not None:
+            consumed.add((f.rule, f.key))
+            exempted.append(Finding(f.rule, f.key, reason))
+        else:
+            findings.append(f)
+    for (rule, key), reason in sorted(exmap.items()):
+        if (rule, key) not in consumed:
+            findings.append(Finding(
+                "close/stale-exemption", "%s %s" % (rule, key),
+                "exemption matches no finding — remove it from "
+                "tools/kubeclose/domains.py (was: %s)" % reason))
+    # exempted combos get their coverage stamped for the manifest
+    exkeys = {key for (rule, key) in exmap
+              if rule == "close/uncaptured-signature"
+              and (rule, key) in consumed}
+    for pc in programs:
+        for combo in pc.combos:
+            if not combo.coverage and combo.key in exkeys:
+                combo.coverage = "exempt"
+                combo.reason = exmap[("close/uncaptured-signature",
+                                      combo.key)]
+    findings.sort(key=lambda f: (f.rule, f.key))
+    exempted.sort(key=lambda f: (f.rule, f.key))
+    return ClosureResult(programs, findings, exempted, list(orphans))
+
+
+def run(root: str = REPO_ROOT) -> ClosureResult:
+    """Load kubetpu, build the engine, extract seams, prove closure."""
+    from tools.kubelint.core import load_modules
+    from . import seams as seams_mod
+    from .engine import ProvenanceEngine
+    modules = load_modules([os.path.join(root, "kubetpu")], root=root)
+    engine = ProvenanceEngine(modules)
+    seam_list, orphans = seams_mod.collect(engine)
+    seam_list.sort(key=lambda s: s.program)
+    return prove(seam_list, orphans)

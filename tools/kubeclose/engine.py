@@ -1,0 +1,746 @@
+"""The interprocedural provenance engine.
+
+Built on kubelint's CallGraph (module scan, import resolution, jit-root
+static params) and deepened four ways the one-level local-name dataflow
+in kubelint's recompile family never had:
+
+  * interprocedural parameter joins — a parameter's provenance is the
+    join of the matching argument at every call site in the analyzed
+    set (plus its literal default when some site omits it), memoized
+    with an in-progress guard so recursion bottoms out at ⊥;
+  * ``self`` resolution — ``self.method(...)`` edges and ``self.attr``
+    reads join over every ``self.attr = ...`` assignment in the class;
+  * constructor field tracking — reads of a dataclass field
+    (``prep.host_ok_dev``) join the matching constructor argument over
+    every construction site (the PreparedCycle plumbing between
+    ``_prepare_group`` and ``_dispatch_group``);
+  * ``aot.dispatch`` seam edges — the seam's args-tuple / kwargs-dict
+    are mapped onto the jitted callee's parameters, so provenance flows
+    THROUGH the seam like a direct call.
+
+Everything is flow-insensitive: a name's provenance is the join over
+all its assignments, which is sound (an over-approximation of any
+execution order) and exactly why branch-correlated exclusions live in
+domains.EXEMPTIONS instead of the lattice.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tools.kubelint.callgraph import CallGraph, FunctionInfo, ModuleInfo
+from tools.kubelint.core import SourceModule
+
+from . import domains
+from .lattice import (BOOL, Prov, canon, const, drop_falsy, join, unbounded)
+
+_IN_PROGRESS = object()
+
+_BUILTIN_BOOL = ("bool", "isinstance", "issubclass", "any", "all",
+                 "callable", "hasattr")
+_BUILTIN_PASS = ("int", "float", "abs", "round")
+_BUILTIN_JOINARGS = ("min", "max")
+
+
+def _last_attr(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        v = expr.value.split("[")[-1].rstrip("]")
+        return v.split(".")[-1]
+    if isinstance(expr, ast.Subscript):
+        # Optional[X] is X-with-a-None-default for provenance purposes
+        if _last_attr(expr.value) == "Optional":
+            return _last_attr(expr.slice)
+        return None
+    return None
+
+
+def _contains_arith(expr: ast.AST) -> bool:
+    return any(isinstance(n, ast.BinOp)
+               and isinstance(n.op, (ast.Add, ast.Sub))
+               for n in ast.walk(expr))
+
+
+class _CallSite:
+    """One resolved call of ``callee``: the argument expressions bound to
+    its parameter names, evaluated in the CALLER's context."""
+
+    __slots__ = ("mi", "caller", "bound", "splat")
+
+    def __init__(self, mi: ModuleInfo, caller: Optional[FunctionInfo],
+                 bound: Dict[str, ast.AST], splat: bool):
+        self.mi = mi
+        self.caller = caller
+        self.bound = bound       # param name -> caller-context expression
+        self.splat = splat       # *args/**kwargs present: unmatched params
+                                 # are unbounded, not defaulted
+
+
+def _params_of(fn_node) -> List[str]:
+    a = getattr(fn_node, "args", None)
+    if a is None:
+        return []
+    return [p.arg for p in a.posonlyargs + a.args]
+
+
+def _default_expr(fn_node, pname: str) -> Optional[ast.AST]:
+    a = getattr(fn_node, "args", None)
+    if a is None:
+        return None
+    pos = a.posonlyargs + a.args
+    firstdef = len(pos) - len(a.defaults)
+    for i, p in enumerate(pos):
+        if p.arg == pname:
+            return a.defaults[i - firstdef] if i >= firstdef else None
+    for i, p in enumerate(a.kwonlyargs):
+        if p.arg == pname:
+            return a.kw_defaults[i]
+    return None
+
+
+def _annotation_of(fn_node, pname: str) -> Optional[ast.AST]:
+    a = getattr(fn_node, "args", None)
+    if a is None:
+        return None
+    for p in a.posonlyargs + a.args + a.kwonlyargs:
+        if p.arg == pname:
+            return p.annotation
+    return None
+
+
+def _bind_call(callee: FunctionInfo, call: ast.Call,
+               bound_recv: bool) -> Tuple[Dict[str, ast.AST], bool]:
+    params = _params_of(callee.node)
+    if bound_recv and params and params[0] in ("self", "cls"):
+        params = params[1:]
+    mapping: Dict[str, ast.AST] = {}
+    splat = False
+    for i, arg in enumerate(call.args):
+        if isinstance(arg, ast.Starred):
+            splat = True
+            break
+        if i < len(params):
+            mapping[params[i]] = arg
+    for kw in call.keywords:
+        if kw.arg is None:
+            splat = True
+        else:
+            mapping[kw.arg] = kw.value
+    return mapping, splat
+
+
+def _is_dispatch(dotted: Optional[str]) -> bool:
+    return bool(dotted) and (dotted == "aot.dispatch"
+                             or dotted.endswith(".aot.dispatch"))
+
+
+def seam_kwarg_exprs(call: ast.Call) -> Dict[str, ast.AST]:
+    """The kwargs-dict expressions of an ``aot.dispatch`` call: accepts
+    both the house ``dict(k=v, ...)`` form and a literal ``{...}``."""
+    if len(call.args) < 4:
+        return {}
+    kw = call.args[3]
+    out: Dict[str, ast.AST] = {}
+    if (isinstance(kw, ast.Call) and isinstance(kw.func, ast.Name)
+            and kw.func.id == "dict"):
+        for k in kw.keywords:
+            if k.arg is not None:
+                out[k.arg] = k.value
+    elif isinstance(kw, ast.Dict):
+        for k, v in zip(kw.keys, kw.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                out[k.value] = v
+    return out
+
+
+class ProvenanceEngine:
+    def __init__(self, modules: Sequence[SourceModule],
+                 callgraph: Optional[CallGraph] = None):
+        self.modules = list(modules)
+        self.cg = callgraph if callgraph is not None else CallGraph(modules)
+        self._qualname: Dict[str, FunctionInfo] = {}
+        self._callsites: Dict[int, List[_CallSite]] = {}
+        self._self_attrs: Dict[Tuple[str, str, str],
+                               List[Tuple[ModuleInfo, FunctionInfo,
+                                          ast.AST]]] = {}
+        # class name -> ordered dataclass field names
+        self._class_fields: Dict[str, List[str]] = {}
+        # class name -> field -> construction-site expressions
+        self._ctor_args: Dict[str, Dict[str, List[
+            Tuple[ModuleInfo, Optional[FunctionInfo], ast.AST]]]] = {}
+        # field name -> owning classes (for unique-field attribute reads)
+        self._field_owner: Dict[str, List[str]] = {}
+        self._dispatch_calls: List[Tuple[ModuleInfo,
+                                         Optional[FunctionInfo],
+                                         ast.Call]] = []
+        self._param_memo: Dict[Tuple[int, str], object] = {}
+        self._name_memo: Dict[Tuple[int, str], object] = {}
+        self._ret_memo: Dict[int, object] = {}
+        self._build_index()
+
+    # ------------------------------------------------------------- indexing
+
+    def _build_index(self) -> None:
+        for mi in self.cg.mods.values():
+            for fi in mi.by_node.values():
+                self._qualname[fi.qualname] = fi
+            for stmt in mi.module.tree.body:
+                if isinstance(stmt, ast.ClassDef):
+                    fields = [t.target.id for t in stmt.body
+                              if isinstance(t, ast.AnnAssign)
+                              and isinstance(t.target, ast.Name)]
+                    if fields:
+                        self._class_fields[stmt.name] = fields
+                        for f in fields:
+                            self._field_owner.setdefault(f, []).append(
+                                stmt.name)
+        for mi in self.cg.mods.values():
+            self._index_module(mi)
+
+    def _index_module(self, mi: ModuleInfo) -> None:
+        for node in ast.walk(mi.module.tree):
+            if isinstance(node, ast.Assign):
+                enc = mi.module.enclosing_function(node)
+                fi = mi.by_node.get(id(enc)) if enc is not None else None
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self" and fi is not None):
+                        cls = self._class_of(fi)
+                        if cls:
+                            self._self_attrs.setdefault(
+                                (mi.module.name, cls, t.attr), []).append(
+                                    (mi, fi, node.value))
+            elif isinstance(node, ast.Call):
+                enc = mi.module.enclosing_function(node)
+                fi = mi.by_node.get(id(enc)) if enc is not None else None
+                dotted = self.cg.resolve_dotted(mi, node.func)
+                if _is_dispatch(dotted):
+                    self._dispatch_calls.append((mi, fi, node))
+                    self._index_dispatch(mi, fi, node)
+                    continue
+                cls = self._ctor_class(mi, node.func)
+                if cls is not None:
+                    self._index_ctor(mi, fi, node, cls)
+                    continue
+                callee, bound = self._resolve_callee(mi, fi, node)
+                if callee is not None:
+                    mapping, splat = _bind_call(callee, node, bound)
+                    self._callsites.setdefault(id(callee), []).append(
+                        _CallSite(mi, fi, mapping, splat))
+
+    def _index_dispatch(self, mi: ModuleInfo, fi: Optional[FunctionInfo],
+                        call: ast.Call) -> None:
+        """Map an ``aot.dispatch(prog, jitfn, (args...), dict(...))``
+        seam onto the jitted callee's parameters."""
+        target = self.dispatch_target(mi, fi, call)
+        if target is None:
+            return
+        params = _params_of(target.node)
+        mapping: Dict[str, ast.AST] = {}
+        if len(call.args) >= 3 and isinstance(call.args[2], ast.Tuple):
+            for i, el in enumerate(call.args[2].elts):
+                if i < len(params):
+                    mapping[params[i]] = el
+        mapping.update(seam_kwarg_exprs(call))
+        self._callsites.setdefault(id(target), []).append(
+            _CallSite(mi, fi, mapping, False))
+
+    def dispatch_target(self, mi: ModuleInfo, fi: Optional[FunctionInfo],
+                        call: ast.Call) -> Optional[FunctionInfo]:
+        if len(call.args) < 2:
+            return None
+        return self._lookup(mi, fi, call.args[1])
+
+    def dispatch_calls(self):
+        return list(self._dispatch_calls)
+
+    def _class_of(self, fi: FunctionInfo) -> Optional[str]:
+        qual = fi.qualname.split(":", 1)[-1]
+        return qual.rsplit(".", 1)[0] if "." in qual else None
+
+    def _ctor_class(self, mi: ModuleInfo, func: ast.AST) -> Optional[str]:
+        name = None
+        if isinstance(func, ast.Name):
+            name = func.id
+            if name in mi.from_imports:
+                name = mi.from_imports[name][1]
+        elif isinstance(func, ast.Attribute):
+            name = func.attr
+        return name if name in self._class_fields else None
+
+    def _index_ctor(self, mi: ModuleInfo, fi: Optional[FunctionInfo],
+                    call: ast.Call, cls: str) -> None:
+        fields = self._class_fields[cls]
+        slots = self._ctor_args.setdefault(cls, {})
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                break
+            if i < len(fields):
+                slots.setdefault(fields[i], []).append((mi, fi, arg))
+        for kw in call.keywords:
+            if kw.arg is not None and kw.arg in fields:
+                slots.setdefault(kw.arg, []).append((mi, fi, kw.value))
+
+    # ------------------------------------------------------ call resolution
+
+    def _lookup(self, mi: ModuleInfo, fi: Optional[FunctionInfo],
+                func: ast.AST) -> Optional[FunctionInfo]:
+        if fi is not None:
+            hit = self.cg._lookup_callee(mi, fi, func)
+            if hit is not None:
+                return hit
+        elif isinstance(func, ast.Name):
+            if func.id in mi.functions:
+                return mi.functions[func.id]
+            if func.id in mi.from_imports:
+                base, orig = mi.from_imports[func.id]
+                other = self.cg.mods.get(base)
+                if other is not None:
+                    return other.functions.get(orig)
+        elif isinstance(func, ast.Attribute) and isinstance(func.value,
+                                                            ast.Name):
+            alias = func.value.id
+            target = None
+            if alias in mi.import_aliases:
+                target = self.cg.mods.get(mi.import_aliases[alias])
+            elif alias in mi.from_imports:
+                base, orig = mi.from_imports[alias]
+                target = self.cg.mods.get((base + "." + orig) if base
+                                          else orig)
+            if target is not None:
+                return target.functions.get(func.attr)
+        return None
+
+    def _resolve_callee(self, mi: ModuleInfo, fi: Optional[FunctionInfo],
+                        call: ast.Call
+                        ) -> Tuple[Optional[FunctionInfo], bool]:
+        func = call.func
+        if (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id in ("self", "cls") and fi is not None):
+            cls = self._class_of(fi)
+            if cls:
+                hit = self._qualname.get(
+                    "%s:%s.%s" % (mi.module.name, cls, func.attr))
+                if hit is not None:
+                    return hit, True
+            return None, False
+        return self._lookup(mi, fi, func), False
+
+    # ---------------------------------------------------------- provenance
+
+    def prov_expr(self, mi: ModuleInfo, fi: Optional[FunctionInfo],
+                  e: ast.AST) -> Optional[Prov]:
+        """Provenance of an expression in (module, function) context.
+        ``None`` is ⊥: an in-progress recursion, joined as identity."""
+        if isinstance(e, ast.Constant):
+            return const((canon(e.value),))
+        if isinstance(e, ast.Name):
+            return self.name_prov(mi, fi, e.id)
+        if isinstance(e, ast.Attribute):
+            return self._prov_attribute(mi, fi, e)
+        if isinstance(e, ast.Call):
+            return self._prov_call(mi, fi, e)
+        if isinstance(e, ast.BoolOp):
+            if isinstance(e.op, ast.Or):
+                acc: Optional[Prov] = None
+                for v in e.values[:-1]:
+                    p = self.prov_expr(mi, fi, v)
+                    acc = join(acc, drop_falsy(p) if p is not None else None)
+                return join(acc, self.prov_expr(mi, fi, e.values[-1]))
+            ps = [self.prov_expr(mi, fi, v) for v in e.values]
+            if all(p is not None and p.label in ("bool", "const")
+                   for p in ps):
+                return BOOL
+            acc = None
+            for p in ps:
+                acc = join(acc, p)
+            return acc
+        if isinstance(e, ast.UnaryOp) and isinstance(e.op, ast.Not):
+            return BOOL
+        if isinstance(e, ast.Compare):
+            return BOOL
+        if isinstance(e, ast.IfExp):
+            return join(self.prov_expr(mi, fi, e.body),
+                        self.prov_expr(mi, fi, e.orelse))
+        if isinstance(e, ast.Subscript):
+            sl = e.slice
+            if (isinstance(sl, ast.Constant) and isinstance(sl.value, str)
+                    and sl.value in domains.STATE_CAPACITY_KEYS):
+                return Prov("pow2-bucketed", None,
+                            "audited capacity key %r "
+                            "(domains.STATE_CAPACITY_KEYS)" % sl.value)
+            return unbounded("subscript of a runtime container")
+        if isinstance(e, ast.NamedExpr):
+            return self.prov_expr(mi, fi, e.value)
+        return unbounded("unmodeled expression %s" % type(e).__name__)
+
+    def _prov_attribute(self, mi: ModuleInfo, fi: Optional[FunctionInfo],
+                        e: ast.Attribute) -> Optional[Prov]:
+        if isinstance(e.value, ast.Name) and e.value.id == "self":
+            if fi is None:
+                return unbounded("self outside a method")
+            cls = self._class_of(fi)
+            sites = self._self_attrs.get(
+                (mi.module.name, cls, e.attr)) if cls else None
+            if not sites:
+                return unbounded("unindexed attribute self.%s" % e.attr)
+            acc: Optional[Prov] = None
+            for smi, sfi, expr in sites:
+                acc = join(acc, self.prov_expr(smi, sfi, expr))
+            return acc
+        base = self.prov_expr(mi, fi, e.value)
+        if base is not None and base.label == "config-constant":
+            owner = base.of.split(".")[0] if base.of else ""
+            classes = ([owner] if owner in domains.CONFIG_CLASSES
+                       else list(domains.CONFIG_CLASSES))
+            for c in classes:
+                dom = domains.CONFIG_FIELD_DOMAINS.get((c, e.attr))
+                if dom is not None:
+                    return Prov("registry-enumerated", frozenset(dom),
+                                "audited domain of %s.%s" % (c, e.attr))
+            # an undeclared field of a per-deployment constant is still a
+            # per-deployment constant — just symbolic, never enumerated
+            return Prov("config-constant", None,
+                        "field of a config constant",
+                        of="%s.%s" % (owner, e.attr) if owner else e.attr)
+        owners = self._field_owner.get(e.attr, [])
+        if owners and (base is None or not base.finite
+                       or base.label == "const"):
+            # joined across EVERY owning class's construction sites — a
+            # sound over-approximation when a field name is shared (the
+            # PreparedCycle/CycleContext `cfg` both carry the same value)
+            acc: Optional[Prov] = None
+            found = False
+            for owner in owners:
+                slots = self._ctor_args.get(owner, {}).get(e.attr)
+                if slots:
+                    for smi, sfi, expr in slots:
+                        acc = join(acc, self.prov_expr(smi, sfi, expr))
+                        found = True
+                else:
+                    dflt = self._field_default(owner, e.attr)
+                    if isinstance(dflt, ast.Constant):
+                        acc = join(acc, const((canon(dflt.value),)))
+                        found = True
+            if found:
+                return acc
+        if base is None:
+            return None
+        return unbounded("attribute .%s of %s value" % (e.attr, base.label))
+
+    def _field_default(self, cls: str, field: str) -> Optional[ast.AST]:
+        for mi in self.cg.mods.values():
+            for stmt in mi.module.tree.body:
+                if isinstance(stmt, ast.ClassDef) and stmt.name == cls:
+                    for t in stmt.body:
+                        if (isinstance(t, ast.AnnAssign)
+                                and isinstance(t.target, ast.Name)
+                                and t.target.id == field):
+                            return t.value
+        return None
+
+    def _prov_call(self, mi: ModuleInfo, fi: Optional[FunctionInfo],
+                   call: ast.Call) -> Optional[Prov]:
+        dotted = self.cg.resolve_dotted(mi, call.func) or ""
+        tail = dotted.rsplit(".", 1)[-1]
+        if tail == "pow2_bucket":
+            if call.args and _contains_arith(call.args[0]):
+                return Prov("pad-capacity", None,
+                            "pow2_bucket of a grown capacity")
+            return Prov("pow2-bucketed", None, "pow2_bucket")
+        if tail in domains.MESH_KEY_FUNCS:
+            return Prov("mesh-key", None, "register_mesh token")
+        if dotted in _BUILTIN_BOOL:
+            return BOOL
+        if dotted in _BUILTIN_PASS and call.args:
+            return self.prov_expr(mi, fi, call.args[0])
+        if dotted in _BUILTIN_JOINARGS:
+            acc: Optional[Prov] = None
+            for a in call.args:
+                acc = join(acc, self.prov_expr(mi, fi, a))
+            return acc
+        if dotted == "len":
+            return unbounded("len() of a runtime container")
+        if (isinstance(call.func, ast.Attribute)
+                and call.func.attr == "_replace"):
+            base = self.prov_expr(mi, fi, call.func.value)
+            if base is None or base.label == "config-constant":
+                return base
+        ctor = self._ctor_class(mi, call.func)
+        if ctor is not None:
+            if ctor in domains.CONFIG_CLASSES:
+                return Prov("config-constant", None,
+                            "constructed %s instance" % ctor, of=ctor)
+            return unbounded("constructed %s instance" % ctor)
+        callee, _bound = self._resolve_callee(mi, fi, call)
+        if callee is not None:
+            return self.return_prov(callee)
+        return unbounded("unresolved call %s" % (dotted or "<expr>"))
+
+    # ------------------------------------------------- names / params / ret
+
+    def name_prov(self, mi: ModuleInfo, fi: Optional[FunctionInfo],
+                  name: str) -> Optional[Prov]:
+        key = (id(fi) if fi is not None else id(mi), name)
+        hit = self._name_memo.get(key)
+        if hit is _IN_PROGRESS:
+            return None
+        if hit is not None or key in self._name_memo:
+            return hit
+        self._name_memo[key] = _IN_PROGRESS
+        try:
+            out = self._name_prov_uncached(mi, fi, name)
+        finally:
+            self._name_memo[key] = None
+        self._name_memo[key] = out
+        return out
+
+    def _name_prov_uncached(self, mi: ModuleInfo,
+                            fi: Optional[FunctionInfo],
+                            name: str) -> Optional[Prov]:
+        acc: Optional[Prov] = None
+        found = False
+        scopes: List[Optional[FunctionInfo]] = [fi]
+        if fi is not None:
+            scopes += self.cg._function_scope_chain(mi, fi)
+        for scope in scopes:
+            if scope is None:
+                continue
+            if name in _params_of(scope.node):
+                acc = join(acc, self.param_prov(scope, name))
+                found = True
+            for node in ast.walk(scope.node):
+                if mi.module.enclosing_function(node) is not scope.node:
+                    continue
+                hit = self._assigned_expr(node, name)
+                if hit is _IN_PROGRESS:     # widened target (loop, aug, …)
+                    acc = join(acc, unbounded(
+                        "widening assignment to %r" % name))
+                    found = True
+                elif hit is not None:
+                    acc = join(acc, self.prov_expr(mi, scope, hit))
+                    found = True
+            if found:
+                return acc
+        if name in mi.module_consts:
+            for stmt in mi.module.tree.body:
+                if isinstance(stmt, ast.Assign):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name) and t.id == name:
+                            acc = join(acc, self.prov_expr(mi, None,
+                                                           stmt.value))
+                            found = True
+                elif (isinstance(stmt, ast.AnnAssign)
+                        and isinstance(stmt.target, ast.Name)
+                        and stmt.target.id == name
+                        and stmt.value is not None):
+                    acc = join(acc, self.prov_expr(mi, None, stmt.value))
+                    found = True
+            if found:
+                return acc
+        return unbounded("unresolved name %r" % name)
+
+    @staticmethod
+    def _assigned_expr(node: ast.AST, name: str):
+        """The assigned expression when ``node`` binds ``name`` exactly,
+        ``_IN_PROGRESS`` when it binds it opaquely, else None."""
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    return node.value
+                if isinstance(t, (ast.Tuple, ast.List)) and any(
+                        isinstance(e, ast.Name) and e.id == name
+                        for e in t.elts):
+                    # element-wise unpack when the RHS is a literal tuple
+                    # of matching arity (the `a, b = (x, y)` idiom)
+                    if (isinstance(node.value, (ast.Tuple, ast.List))
+                            and len(node.value.elts) == len(t.elts)
+                            and not any(isinstance(e, ast.Starred)
+                                        for e in t.elts)):
+                        for tgt, val in zip(t.elts, node.value.elts):
+                            if isinstance(tgt, ast.Name) and tgt.id == name:
+                                return val
+                    return _IN_PROGRESS
+        elif isinstance(node, ast.AnnAssign):
+            if (isinstance(node.target, ast.Name)
+                    and node.target.id == name):
+                return node.value if node.value is not None else None
+        elif isinstance(node, ast.AugAssign):
+            if isinstance(node.target, ast.Name) and node.target.id == name:
+                return _IN_PROGRESS
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            for t in ast.walk(node.target):
+                if isinstance(t, ast.Name) and t.id == name:
+                    return _IN_PROGRESS
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    for t in ast.walk(item.optional_vars):
+                        if isinstance(t, ast.Name) and t.id == name:
+                            return _IN_PROGRESS
+        elif isinstance(node, ast.NamedExpr):
+            if isinstance(node.target, ast.Name) and node.target.id == name:
+                return node.value
+        return None
+
+    def name_defs(self, mi: ModuleInfo, fi: Optional[FunctionInfo],
+                  name: str) -> List[Tuple[ModuleInfo,
+                                           Optional[FunctionInfo],
+                                           ast.AST]]:
+        """The defining EXPRESSIONS of a name (assignments in the scope
+        chain, call-site arguments and defaults when it is a parameter,
+        module constants) — the expression-level mirror of name_prov,
+        consumed by kubelint's recompile family for interprocedural
+        shape/len tracing."""
+        defs: List[Tuple[ModuleInfo, Optional[FunctionInfo], ast.AST]] = []
+        scopes: List[Optional[FunctionInfo]] = [fi]
+        if fi is not None:
+            scopes += self.cg._function_scope_chain(mi, fi)
+        for scope in scopes:
+            if scope is None:
+                continue
+            found = False
+            if name in _params_of(scope.node) + [
+                    a.arg for a in scope.node.args.kwonlyargs]:
+                found = True
+                dflt = _default_expr(scope.node, name)
+                for site in self._callsites.get(id(scope), []):
+                    if name in site.bound:
+                        defs.append((site.mi, site.caller,
+                                     site.bound[name]))
+                    elif not site.splat and dflt is not None:
+                        defs.append((site.mi, None, dflt))
+            for node in ast.walk(scope.node):
+                if mi.module.enclosing_function(node) is not scope.node:
+                    continue
+                hit = self._assigned_expr(node, name)
+                if hit is _IN_PROGRESS:
+                    found = True             # opaque binding: no expr
+                elif hit is not None:
+                    defs.append((mi, scope, hit))
+                    found = True
+            if found:
+                return defs
+        for stmt in mi.module.tree.body:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name) and t.id == name:
+                        defs.append((mi, None, stmt.value))
+            elif (isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)
+                    and stmt.target.id == name and stmt.value is not None):
+                defs.append((mi, None, stmt.value))
+        return defs
+
+    def resolve_name_exprs(self, mi: ModuleInfo,
+                           fi: Optional[FunctionInfo], name: str,
+                           limit: int = 64
+                           ) -> List[Tuple[ModuleInfo,
+                                           Optional[FunctionInfo],
+                                           ast.AST]]:
+        """Transitively resolve a name to its non-Name defining
+        expressions across call boundaries (bounded, cycle-safe)."""
+        out: List[Tuple[ModuleInfo, Optional[FunctionInfo], ast.AST]] = []
+        seen = set()
+        work = [(mi, fi, ast.Name(id=name))]
+        while work and len(out) < limit:
+            wmi, wfi, e = work.pop()
+            if isinstance(e, ast.Name):
+                key = (id(wfi) if wfi is not None else id(wmi), e.id)
+                if key in seen:
+                    continue
+                seen.add(key)
+                work.extend(self.name_defs(wmi, wfi, e.id))
+            else:
+                out.append((wmi, wfi, e))
+        return out
+
+    def param_prov(self, fi: FunctionInfo, pname: str) -> Optional[Prov]:
+        key = (id(fi), pname)
+        hit = self._param_memo.get(key)
+        if hit is _IN_PROGRESS:
+            return None
+        if hit is not None or key in self._param_memo:
+            return hit
+        self._param_memo[key] = _IN_PROGRESS
+        try:
+            out = self._param_prov_uncached(fi, pname)
+        finally:
+            self._param_memo[key] = None
+        self._param_memo[key] = out
+        return out
+
+    def _param_prov_uncached(self, fi: FunctionInfo,
+                             pname: str) -> Optional[Prov]:
+        if pname in ("self", "cls"):
+            return unbounded("method receiver")
+        ann = _annotation_of(fi.node, pname)
+        ann_name = _last_attr(ann) if ann is not None else None
+        if ann_name in domains.CONFIG_CLASSES:
+            return Prov("config-constant", None,
+                        "parameter annotated %s" % ann_name, of=ann_name)
+        sites = self._callsites.get(id(fi), [])
+        acc: Optional[Prov] = None
+        if not sites:
+            acc = unbounded("no analyzed call sites for %s(%s)"
+                            % (fi.name, pname))
+        dflt = _default_expr(fi.node, pname)
+        for site in sites:
+            if pname in site.bound:
+                acc = join(acc, self.prov_expr(site.mi, site.caller,
+                                               site.bound[pname]))
+            elif site.splat:
+                acc = join(acc, unbounded(
+                    "splatted call site of %s" % fi.name))
+            elif dflt is not None:
+                acc = join(acc, self.prov_expr(site.mi, None, dflt))
+            else:
+                acc = join(acc, unbounded(
+                    "unbound required parameter %s at a call site"
+                    % pname))
+        # a bool annotation is the declared contract: when the call-site
+        # join widens (an unresolved caller, a method boundary), {True,
+        # False} is still the sound finite domain — but a PRECISE join
+        # (both serving sites pass True) is kept, not widened to BOOL
+        if ann_name == "bool" and (acc is None or not acc.finite):
+            return BOOL
+        return acc
+
+    def return_prov(self, fi: FunctionInfo) -> Optional[Prov]:
+        key = id(fi)
+        hit = self._ret_memo.get(key)
+        if hit is _IN_PROGRESS:
+            return None
+        if hit is not None or key in self._ret_memo:
+            return hit
+        self._ret_memo[key] = _IN_PROGRESS
+        try:
+            out = self._return_prov_uncached(fi)
+        finally:
+            self._ret_memo[key] = None
+        self._ret_memo[key] = out
+        return out
+
+    def _return_prov_uncached(self, fi: FunctionInfo) -> Optional[Prov]:
+        mi = self.cg.mods[fi.module.name]
+        acc: Optional[Prov] = None
+        found = False
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Return):
+                continue
+            if mi.module.enclosing_function(node) is not fi.node:
+                continue
+            found = True
+            if node.value is None:
+                acc = join(acc, const(("None",), "bare return"))
+            else:
+                acc = join(acc, self.prov_expr(mi, fi, node.value))
+        if not found:
+            return const(("None",), "function never returns a value")
+        return acc

@@ -1,0 +1,198 @@
+"""CLOSURE_MANIFEST.json: serialization, drift diffing, and the
+pure-JSON re-validation the no-jax CI gate runs first.
+
+The committed manifest is the version-controlled compile-surface
+closure — per seamed program, the proved axis table (fixed / symbolic /
+crossed), the enumerated reachable signature combos with their coverage
+(a kubecensus registry row, or a structured exemption naming the
+fallback path), and the committed environment.  Two consumers:
+
+* CI (``python -m tools.kubeclose``): re-proves the closure over the
+  tree and fails on drift in either direction — an enumerated combo
+  absent from the committed file (the reachable surface grew silently)
+  or a committed combo the prover no longer reaches (dead closure row).
+* CI without jax (``python -m tools.kubeclose --check``): re-validates
+  the committed file alone — every combo covered, every registry
+  coverage pointer resolving to a COMPILE_MANIFEST.json row, every
+  AOT-seamed program's covering rows present in AOT_INDEX.json, and the
+  environment byte-equal to tools/kubeexact/northstar.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from tools.kubeexact import northstar
+
+from .closure import REPO_ROOT, ClosureResult, entry_key
+
+MANIFEST_PATH = os.path.join(REPO_ROOT, "CLOSURE_MANIFEST.json")
+CENSUS_PATH = os.path.join(REPO_ROOT, "COMPILE_MANIFEST.json")
+AOT_INDEX_PATH = os.path.join(REPO_ROOT, "tools", "kubeaot",
+                              "AOT_INDEX.json")
+
+_COMMENT = ("Compile-surface closure (tools/kubeclose). Regenerate: make "
+            "close (python -m tools.kubeclose --write). CI fails on drift "
+            "in either direction; --check re-validates this file without "
+            "jax.")
+
+
+def build_manifest(res: ClosureResult) -> dict:
+    programs: Dict[str, dict] = {}
+    for pc in res.programs:
+        programs[pc.seam.program] = {
+            "target": pc.seam.target,
+            "site": _relsite(pc.seam.site),
+            "axes": {n: ax.to_json() for n, ax in pc.seam.axes.items()},
+            "fixed": dict(pc.fixed),
+            "symbolic": dict(pc.symbolic),
+            "combos": {c.key: c.to_json() for c in pc.combos},
+        }
+    return {
+        "_comment": _COMMENT,
+        "northstar_env": dict(northstar.NORTHSTAR_ENV),
+        "programs": programs,
+        "findings": [f.to_json() for f in res.findings],
+        "exemptions": [f.to_json() for f in res.exempted],
+        "counts": {
+            "programs": len(programs),
+            "combos": sum(len(p["combos"]) for p in programs.values()),
+            "covered": sum(
+                1 for p in programs.values()
+                for c in p["combos"].values()
+                if c["coverage"].startswith("registry:")),
+            "exempt": sum(1 for p in programs.values()
+                          for c in p["combos"].values()
+                          if c["coverage"] == "exempt"),
+            "findings": len(res.findings),
+        },
+    }
+
+
+def _relsite(site: str) -> str:
+    path, _, line = site.rpartition(":")
+    if os.path.isabs(path):
+        path = os.path.relpath(path, REPO_ROOT)
+    return "%s:%s" % (path, line)
+
+
+def write_manifest(doc: dict, path: str = None) -> str:
+    """Deterministic serialization: sorted keys, fixed indent, trailing
+    newline — regeneration over an unchanged tree is byte-identical."""
+    path = path or MANIFEST_PATH
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def load_manifest(path: str = None) -> Optional[dict]:
+    path = path or MANIFEST_PATH
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def diff_manifest(current: dict,
+                  committed: Optional[dict]) -> Dict[str, list]:
+    """Two-directional drift over (program, combo) keys plus
+    watched-content changes."""
+    if committed is None:
+        return {"added": sorted(current.get("programs", {})),
+                "removed": [], "changed": [], "missing_manifest": True}
+    cur = current.get("programs", {})
+    com = committed.get("programs", {})
+    added = sorted(set(cur) - set(com))
+    removed = sorted(set(com) - set(cur))
+    changed = []
+    if current.get("northstar_env") != committed.get("northstar_env"):
+        changed.append("<northstar_env>")
+    if current.get("findings") != committed.get("findings"):
+        changed.append("<findings>")
+    if current.get("exemptions") != committed.get("exemptions"):
+        changed.append("<exemptions>")
+    watched = ("axes", "fixed", "symbolic", "combos", "target")
+    for k in sorted(set(cur) & set(com)):
+        for w in watched:
+            if cur[k].get(w) != com[k].get(w):
+                changed.append("%s (%s)" % (k, w))
+                break
+    return {"added": added, "removed": removed, "changed": changed}
+
+
+# ---------------------------------------------------------------- --check
+
+def _census_keys(census_path: str = None) -> Optional[set]:
+    path = census_path or CENSUS_PATH
+    try:
+        with open(path) as f:
+            rows = json.load(f)["rows"]
+    except (OSError, ValueError, KeyError):
+        return None
+    return {entry_key(r["program"], r.get("tag") or "") for r in rows}
+
+
+def _aot_programs(aot_path: str = None) -> Optional[set]:
+    path = aot_path or AOT_INDEX_PATH
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return {r.get("program") for r in doc.get("rows", [])}
+
+
+def check_manifest(doc: Optional[dict], census_path: str = None,
+                   aot_path: str = None) -> List[str]:
+    """Pure-JSON re-validation of the committed closure (no jax, no AST
+    walk of kubetpu).  Returns failure strings; empty means green."""
+    fails: List[str] = []
+    if doc is None:
+        return ["no committed CLOSURE_MANIFEST.json — run --write"]
+    if doc.get("northstar_env") != northstar.NORTHSTAR_ENV:
+        fails.append("northstar_env drifted from tools/kubeexact/"
+                     "northstar.py — regenerate with --write")
+    if doc.get("findings"):
+        fails.append("committed manifest carries %d open finding(s) — "
+                     "the closure is not proved"
+                     % len(doc.get("findings")))
+    census = _census_keys(census_path)
+    if census is None:
+        fails.append("cannot read COMPILE_MANIFEST.json")
+    aot = _aot_programs(aot_path)
+    if aot is None:
+        fails.append("cannot read tools/kubeaot/AOT_INDEX.json")
+    for program, prog in sorted((doc.get("programs") or {}).items()):
+        combos = prog.get("combos") or {}
+        for key, combo in sorted(combos.items()):
+            cov = combo.get("coverage", "")
+            if cov.startswith("registry:"):
+                rk = cov.split(":", 1)[1]
+                if census is not None and rk not in census:
+                    fails.append("%s: coverage row %r has no "
+                                 "COMPILE_MANIFEST.json row" % (key, rk))
+            elif cov == "exempt":
+                if not combo.get("reason"):
+                    fails.append("%s: exempt combo without a reason "
+                                 "naming its fallback path" % key)
+            else:
+                fails.append("%s: combo is neither registry-covered nor "
+                             "exempt" % key)
+        for axis, ax in sorted((prog.get("axes") or {}).items()):
+            if ax.get("label") == "unbounded":
+                fails.append("%s: axis %r committed as unbounded — the "
+                             "closure is not proved" % (program, axis))
+        if aot is not None and program in aot and not combos:
+            fails.append("%s: AOT-indexed program with an empty combo "
+                         "set" % program)
+    if aot is not None:
+        progs = set(doc.get("programs") or {})
+        for p in sorted(aot - progs):
+            fails.append("AOT_INDEX program %r is outside the closure — "
+                         "an artifact for a seam the prover cannot see"
+                         % p)
+    return fails

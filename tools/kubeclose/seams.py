@@ -1,0 +1,223 @@
+"""Dispatch-seam extraction: from ``aot.dispatch`` call sites (and the
+declarative ``domains.EXTRA_ROOTS`` jit-pair entries) to per-program axis
+tables the closure enumerates.
+
+A seam's AXES are the degrees of freedom of its compiled-signature key:
+
+* every STATIC position (``static_argnums`` indices into the seam's
+  args-tuple, ``static_argnames`` keys into its kwargs-dict), carrying
+  the interprocedural provenance join of the expression the seam passes;
+* every optional dynamic kwarg whose jitted default is ``None`` — its
+  PRESENCE flips the call treedef (utils/aot.py call_signature drops a
+  None-for-None kwarg from the call), so {absent, present} is a closure
+  axis even though the value itself is traced.
+
+Axis classification:
+
+* ``enumerated`` — the provenance carries an explicit value set (const /
+  bool / registry-enumerated): crossed by the closure when multi-valued,
+  recorded as ``fixed`` when single-valued;
+* ``symbolic``   — finite without explicit values (config-constant,
+  mesh-key, pow2-bucketed, pad-capacity): recorded, never crossed — the
+  ladder/profile bound is the finiteness argument;
+* anything else  — a ``close/unbounded-static`` problem, and an
+  int-annotated static position whose finite class is neither a literal
+  int set nor the pow2/pad ladder is ``close/unbucketed-shape``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from .engine import (ProvenanceEngine, _annotation_of, _default_expr,
+                     _last_attr, _params_of, seam_kwarg_exprs)
+from .lattice import FINITE_SYMBOLIC, Prov, presence
+
+
+@dataclasses.dataclass
+class SeamAxis:
+    name: str
+    kind: str                          # "static" | "presence"
+    label: str                         # lattice label ("presence" axes: -)
+    values: Optional[Tuple[str, ...]]  # sorted canonical reprs, or None
+    why: str
+
+    @property
+    def enumerated(self) -> bool:
+        return self.values is not None
+
+    def to_json(self) -> dict:
+        return {"kind": self.kind, "label": self.label,
+                "values": list(self.values) if self.values is not None
+                else None,
+                "why": self.why}
+
+
+@dataclasses.dataclass
+class SeamProblem:
+    rule: str                          # close/unbounded-static | ...
+    program: str
+    axis: str
+    detail: str
+
+    @property
+    def key(self) -> str:
+        return "%s %s" % (self.program, self.axis)
+
+
+@dataclasses.dataclass
+class Seam:
+    program: str
+    target: str                        # jitted callee qualname mod:fn
+    site: str                          # path:lineno of the dispatch call
+    axes: Dict[str, SeamAxis]
+    problems: List[SeamProblem]
+
+
+def _int_values(values) -> bool:
+    for v in values:
+        try:
+            int(v)
+        except ValueError:
+            return False
+    return True
+
+
+def _static_names(call: ast.Call, params: List[str]) -> List[str]:
+    names: List[str] = []
+    for kw in call.keywords:
+        if kw.arg == "static_argnums" and isinstance(kw.value,
+                                                     (ast.Tuple, ast.List)):
+            for el in kw.value.elts:
+                if (isinstance(el, ast.Constant)
+                        and isinstance(el.value, int)
+                        and el.value < len(params)):
+                    names.append(params[el.value])
+        elif kw.arg == "static_argnames" and isinstance(
+                kw.value, (ast.Tuple, ast.List)):
+            for el in kw.value.elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value,
+                                                               str):
+                    names.append(el.value)
+    return names
+
+
+def _classify_static(program: str, name: str, p: Optional[Prov],
+                     target_node, problems: List[SeamProblem]) -> SeamAxis:
+    if p is None or p.label == "unbounded":
+        problems.append(SeamProblem(
+            "close/unbounded-static", program, name,
+            "static position %r joins to unbounded provenance: %s"
+            % (name, p.why if p is not None else "bottom (unreached)")))
+        return SeamAxis(name, "static", "unbounded", None,
+                        p.why if p is not None else "bottom")
+    ann = _annotation_of(target_node, name)
+    if _last_attr(ann) == "int" if ann is not None else False:
+        ok = (p.label in ("pow2-bucketed", "pad-capacity")
+              or (p.values is not None
+                  and _int_values(p.values - frozenset(("None",)))))
+        if not ok:
+            problems.append(SeamProblem(
+                "close/unbucketed-shape", program, name,
+                "int static %r is %s — a shape-determining static must "
+                "flow through pow2_bucket or be a literal ladder rung"
+                % (name, p.label)))
+    if p.enumerable:
+        return SeamAxis(name, "static", p.label, tuple(sorted(p.values)),
+                        p.why)
+    if p.label in FINITE_SYMBOLIC:
+        return SeamAxis(name, "static", p.label, None,
+                        (p.of + ": " if p.of else "") + p.why)
+    # finite label without values outside the symbolic classes (an
+    # enumerable label that lost its set): treat as unbounded
+    problems.append(SeamProblem(
+        "close/unbounded-static", program, name,
+        "static %r has finite label %r but no value set (%s)"
+        % (name, p.label, p.why)))
+    return SeamAxis(name, "static", "unbounded", None, p.why)
+
+
+def collect(engine: ProvenanceEngine) -> Tuple[List[Seam],
+                                               List[SeamProblem]]:
+    """All dispatch seams plus EXTRA_ROOTS, with their axis tables."""
+    from . import domains
+    seams: List[Seam] = []
+    orphan: List[SeamProblem] = []
+    for mi, fi, call in engine.dispatch_calls():
+        if not (call.args and isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, str)):
+            orphan.append(SeamProblem(
+                "close/unbounded-static", "<unknown>", "program",
+                "aot.dispatch with a non-literal program name at %s:%d"
+                % (mi.module.path, call.lineno)))
+            continue
+        program = call.args[0].value
+        target = engine.dispatch_target(mi, fi, call)
+        if target is None:
+            orphan.append(SeamProblem(
+                "close/unbounded-static", program, "<target>",
+                "cannot resolve the jitted callee of the %s seam" % program))
+            continue
+        params = _params_of(target.node)
+        statics = _static_names(call, params)
+        kwargs = seam_kwarg_exprs(call)
+        pos: Dict[str, ast.AST] = {}
+        if len(call.args) >= 3 and isinstance(call.args[2], ast.Tuple):
+            for i, el in enumerate(call.args[2].elts):
+                if i < len(params):
+                    pos[params[i]] = el
+        axes: Dict[str, SeamAxis] = {}
+        problems: List[SeamProblem] = []
+        for name in statics:
+            expr = kwargs.get(name, pos.get(name))
+            if expr is not None:
+                p = engine.prov_expr(mi, fi, expr)
+            else:
+                dflt = _default_expr(target.node, name)
+                p = (engine.prov_expr(mi, None, dflt)
+                     if dflt is not None else None)
+            axes[name] = _classify_static(program, name, p, target.node,
+                                          problems)
+        for name, expr in kwargs.items():
+            if name in statics:
+                continue
+            dflt = _default_expr(target.node, name)
+            if not (isinstance(dflt, ast.Constant) and dflt.value is None):
+                continue   # always-materialized dynamic arg: no treedef axis
+            pres = presence(engine.prov_expr(mi, fi, expr))
+            axes[name] = SeamAxis(name, "presence", "presence", pres,
+                                  "optional traced kwarg (None default "
+                                  "drops from the call treedef)")
+        seams.append(Seam(program, target.qualname,
+                          "%s:%d" % (mi.module.path, call.lineno),
+                          axes, problems))
+    for root in domains.EXTRA_ROOTS:
+        seams.append(_extra_root_seam(engine, root, orphan))
+    return [s for s in seams if s is not None], orphan
+
+
+def _extra_root_seam(engine: ProvenanceEngine, root: dict,
+                     orphan: List[SeamProblem]) -> Optional[Seam]:
+    program = root["program"]
+    entry = engine._qualname.get(root["entry"])
+    if entry is None:
+        orphan.append(SeamProblem(
+            "close/unbounded-static", program, "<entry>",
+            "EXTRA_ROOTS entry %s not found in the analyzed set"
+            % root["entry"]))
+        return None
+    axes: Dict[str, SeamAxis] = {}
+    problems: List[SeamProblem] = []
+    for axis, pname in root.get("axes", {}).items():
+        p = engine.param_prov(entry, pname)
+        axes[axis] = _classify_static(program, axis, p, entry.node,
+                                      problems)
+    for axis, label in root.get("symbolic", {}).items():
+        axes[axis] = SeamAxis(axis, "static", label, None,
+                              "declared symbolic axis (domains.EXTRA_ROOTS)")
+    mi = engine.cg.mods[entry.module.name]
+    return Seam(program, entry.qualname,
+                "%s:%d" % (mi.module.path, entry.node.lineno),
+                axes, problems)

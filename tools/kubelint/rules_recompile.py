@@ -23,6 +23,13 @@ Rules:
                               in pow2_bucket(...) — every new size
                               compiles a fresh program instead of hitting
                               the pow2 bucket (utils/intern.py contract).
+                              Checked through the interprocedural
+                              provenance engine (tools/kubeclose/
+                              engine.py): a bare name is resolved to its
+                              defining expressions across assignments,
+                              parameters and call sites, so laundering a
+                              len(...) through a local or a helper
+                              parameter no longer hides it.
   recompile/shape-branch      an if/while test inside a traced function
                               comparing .shape[...] against a call result
                               — a shape-dependent Python branch whose
@@ -46,6 +53,34 @@ from typing import List, Optional, Set
 from .core import Finding, SourceModule
 
 _JIT_LIKE = {"jax.jit", "jax.pmap"}
+
+
+def _engine(ctx):
+    """The shared interprocedural provenance engine (tools/kubeclose),
+    built lazily once per lint run over the run's modules/callgraph.
+    Import is deferred: kubeclose depends on kubelint's callgraph, so a
+    module-level import here would be circular."""
+    eng = getattr(ctx, "_provenance_engine", None)
+    if eng is None:
+        from tools.kubeclose.engine import ProvenanceEngine
+        eng = ProvenanceEngine(ctx.modules, callgraph=ctx.callgraph)
+        ctx._provenance_engine = eng
+    return eng
+
+
+def _resolved_shape_leak(ctx, cg, mi, caller, v):
+    """Interprocedural unbucketed-shape check for a bare-name argument:
+    resolve the name to its defining expressions (through assignments,
+    parameters, call sites) and apply the same syntactic test to each.
+    Returns the offending (module, expr) or None."""
+    if not isinstance(v, ast.Name):
+        return None
+    for dmi, _dfi, dexpr in _engine(ctx).resolve_name_exprs(
+            mi, caller, v.id):
+        if (_contains_shape_or_len(cg, dmi, dexpr)
+                and not _is_pow2_bucketed(cg, dmi, dexpr)):
+            return dmi, dexpr
+    return None
 
 
 def _static_params_of(callee) -> Set[str]:
@@ -122,6 +157,8 @@ def check(module: SourceModule, ctx) -> List[Finding]:
         # ---- pallas grid/block dimension hygiene -----------------------
         if dotted and dotted.split(".")[-1] == "pallas_call":
             enc_fn = module.enclosing_function(node)
+            enc_fi = (cg.info_for(module, enc_fn)
+                      if enc_fn is not None else None)
             grids = []
             for kw in node.keywords:
                 if kw.arg == "grid":
@@ -130,13 +167,13 @@ def check(module: SourceModule, ctx) -> List[Finding]:
                     grids += [kw2.value for kw2 in kw.value.keywords
                               if kw2.arg == "grid"]
             for g in grids:
-                _pallas_dim_findings(cg, mi, module, enc_fn, g, "grid",
-                                     out)
+                _pallas_dim_findings(ctx, cg, mi, module, enc_fi, g,
+                                     "grid", out)
             for sub in ast.walk(node):
                 if (isinstance(sub, ast.Call) and sub.args
                         and (cg.resolve_dotted(mi, sub.func) or ""
                              ).split(".")[-1] == "BlockSpec"):
-                    _pallas_dim_findings(cg, mi, module, enc_fn,
+                    _pallas_dim_findings(ctx, cg, mi, module, enc_fi,
                                          sub.args[0], "block", out)
 
         # ---- static-arg hygiene at call sites --------------------------
@@ -176,6 +213,21 @@ def check(module: SourceModule, ctx) -> List[Finding]:
                         "every new size compiles a fresh program "
                         "(utils/intern.py bucketing contract)"
                         % (name, callee.name)))
+                else:
+                    leak = _resolved_shape_leak(ctx, cg, mi, caller, v)
+                    if leak is not None:
+                        dmi, dexpr = leak
+                        out.append(Finding(
+                            "recompile/unbucketed-static", module.path,
+                            v.lineno, v.col_offset + 1,
+                            "`%s` reaches static parameter `%s` of jitted "
+                            "`%s` carrying a shape-derived value without "
+                            "pow2_bucket(...) (defined at %s:%d, resolved "
+                            "interprocedurally) — every new size compiles "
+                            "a fresh program"
+                            % (v.id, name, callee.name,
+                               dmi.module.name,
+                               getattr(dexpr, "lineno", 0))))
 
     # ---- mutable defaults on static params -----------------------------
     for mi_fi in mi.by_node.values():
@@ -233,34 +285,36 @@ def check(module: SourceModule, ctx) -> List[Finding]:
     return out
 
 
-def _resolve_local_name(module: SourceModule, fn, name: str):
-    """Most recent simple `name = expr` assignment in fn (or at module
-    level) — one-level dataflow so `grid=grid` still gets inspected."""
-    scope = fn if fn is not None else module.tree
-    found = None
-    for stmt in ast.walk(scope):
-        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
-            t = stmt.targets[0]
-            if isinstance(t, ast.Name) and t.id == name:
-                found = stmt.value
-    return found
+def _resolve_dim_exprs(ctx, mi, fi, expr: ast.AST):
+    """Interprocedural replacement for the old one-level local-name
+    lookup: a bare-name grid/block dimension resolves to EVERY defining
+    expression the provenance engine can reach (assignments in the scope
+    chain, parameter bindings at call sites, module constants) — so
+    `grid=grid` still gets inspected, and so does a dim laundered
+    through a helper parameter two frames up."""
+    if not isinstance(expr, ast.Name):
+        return [expr]
+    resolved = [e for _dmi, _dfi, e in _engine(ctx).resolve_name_exprs(
+        mi, fi, expr.id)]
+    return resolved or [expr]
 
 
-def _pallas_dim_findings(cg, mi, module: SourceModule, fn, expr: ast.AST,
-                         what: str, out: List[Finding]) -> None:
+def _pallas_dim_findings(ctx, cg, mi, module: SourceModule, fi,
+                         expr: ast.AST, what: str,
+                         out: List[Finding]) -> None:
     """Flag unbucketed-dynamic pallas grid/block dimensions: len(...) of a
     host container, or floor division of a shape-derived value outside
     the ceil-division idiom.  pow2_bucket(...)/cdiv(...) subtrees are
     blessed.  Plain .shape reads pass — aval shapes are already bucketed
     upstream by the tensorizer's pow2 contract."""
-    e = expr
-    if isinstance(e, ast.Name):
-        e = _resolve_local_name(module, fn, e.id) or e
-    comps = list(e.elts) if isinstance(e, ast.Tuple) else [e]
+    exprs = _resolve_dim_exprs(ctx, mi, fi, expr)
+    comps = []
+    for e in exprs:
+        comps += list(e.elts) if isinstance(e, ast.Tuple) else [e]
+    resolved_comps = []
     for comp in comps:
-        c = comp
-        if isinstance(c, ast.Name):
-            c = _resolve_local_name(module, fn, c.id) or c
+        resolved_comps += _resolve_dim_exprs(ctx, mi, fi, comp)
+    for c in resolved_comps:
         blessed = set()
         for nd in ast.walk(c):
             if isinstance(nd, ast.Call):
