@@ -5,7 +5,8 @@
 .PHONY: help lint lock-graph test sanitize-test race-test flight-test \
 	delta-test census census-test aot aot-test pallas-test chaos-test \
 	slo-test pipeline-test journal-test replay-test devstats-test \
-	mesh-test exact exact-test close close-test trend trace bench
+	mesh-test exact exact-test close close-test load-test load-soak \
+	trend trace bench
 
 help:
 	@echo "kubetpu targets:"
@@ -109,6 +110,14 @@ help:
 	@echo "                      --check under a jax import blocker, stale-"
 	@echo "                      exemption audit, serving-path dispatch-"
 	@echo "                      signature membership e2e"
+	@echo "  make load-test      sustained-load telemetry plane suite"
+	@echo "                      (utils/telemetry.py + harness streams +"
+	@echo "                      SustainedLoadRunner): window-delta-vs-numpy"
+	@echo "                      exactness, ring wrap/drop bounds, disarmed"
+	@echo "                      poison, parity golden, chaos-window"
+	@echo "                      attribution, /debug/loadz + /metrics"
+	@echo "  make load-soak      minutes-scale open-loop soak (slow-marked):"
+	@echo "                      steady-state span found, zero demotions"
 	@echo "  make trend          per-case bench trend table over the committed"
 	@echo "                      BENCH_r*.json trajectory with per-stage"
 	@echo "                      regression attribution (tools/benchtrend.py)"
@@ -269,6 +278,23 @@ close:
 close-test:
 	JAX_PLATFORMS=cpu python -m pytest \
 		tests/test_kubeclose.py -q -p no:cacheprovider
+
+# sustained-load telemetry plane (kubetpu/utils/telemetry.py + the
+# open-loop harness streams in kubetpu/harness/hollow.py + perf.py
+# SustainedLoadRunner): window-delta merge exactness vs numpy, ring
+# wrap + drop counting, the disarmed zero-cost poison test, the
+# armed-vs-disarmed placement-parity golden, seeded chaos-storm
+# window attribution, /debug/loadz and the /metrics window series
+load-test:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_telemetry.py -q -m 'not slow' -p no:cacheprovider
+
+# the minutes-scale sustained soak (excluded from tier-1 via the slow
+# marker): a live open-loop Poisson stream must reach a steady-state
+# span with zero recovery-ladder demotions and a bounded ring
+load-soak:
+	JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_telemetry.py -q -m slow -p no:cacheprovider
 
 # bench trend table + regression attribution over the committed rounds
 trend:

@@ -487,6 +487,15 @@ def gate_entries(detail, northstar=None):
     if isinstance(p99, (int, float)) and p99 > 0:
         out["rescore_p99_s"] = {"seconds": round(p99, 3), "max_frac": 2.0,
                                 "path": path}
+    # sustained-load steady-state p99 CEILING (ROADMAP item 3's
+    # open-loop axis): the windowed steady-state pod e2e p99 under the
+    # seeded Poisson arrival stream, warmup excluded by the slope test
+    # (utils/telemetry.py) — NOT a run-cumulative quantile
+    sp = detail.get("sustained_load", {}).get("steady_p99_s")
+    if isinstance(sp, (int, float)) and sp > 0:
+        out["sustained_steady_p99_s"] = {
+            "seconds": round(sp, 3), "max_frac": 2.0,
+            "path": "sustained_load.steady_p99_s"}
     return out
 
 
@@ -543,6 +552,35 @@ def northstar_gate(detail, path="NORTHSTAR.json"):
         failures.append(
             "replay_fidelity: a pipelineDepth counterfactual changed "
             "placements — executor depth leaked into a device program")
+    # the sustained-load steady-state contract rides the gate whenever
+    # the case ran (no recorded floor needed): telemetry must be
+    # write-only observability, the run must REACH steady state, and a
+    # healthy stream admits no recovery demotions and completes what it
+    # offers (coordinated-omission defense: the offered denominator is
+    # the stream's, not the scheduler's)
+    sl = detail.get("sustained_load", {})
+    if sl and "error" not in sl:
+        if sl.get("placements_match") is False:
+            failures.append(
+                "sustained_load: armed-vs-disarmed placements diverged "
+                "(telemetry is write-only observability, "
+                "kubetpu/utils/telemetry.py)")
+        if ("steady_windows" in sl
+                and int(sl.get("steady_windows") or 0) < 6):
+            failures.append(
+                f"sustained_load: only {int(sl.get('steady_windows') or 0)}"
+                " steady-state windows (need >= 6 post-warmup windows "
+                "passing the slope test)")
+        if int(sl.get("demotions") or 0) > 0:
+            failures.append(
+                f"sustained_load: {int(sl.get('demotions') or 0)} recovery"
+                "-ladder demotions during a healthy stream (must be 0)")
+        cf = sl.get("completed_frac")
+        if isinstance(cf, (int, float)) and cf < 0.95:
+            failures.append(
+                f"sustained_load: completed/offered = {cf} (must be "
+                ">= 0.95 — the scheduler fell behind the open-loop "
+                "offered rate)")
     try:
         with open(path) as f:
             doc = json.load(f)
@@ -969,6 +1007,196 @@ def replay_fidelity_case(n_nodes=12, n_pods=240, batch=8, depth=4):
             sched.close()
         ujournal.disarm_journal()
         shutil.rmtree(work, ignore_errors=True)
+
+
+def sustained_load_case(n_nodes=64, rate=None, duration_s=None,
+                        window_s=None):
+    """Sustained open-loop load with steady-state telemetry (ROADMAP
+    item 3's arrival-process axis): a seeded Poisson arrival stream
+    (kubetpu/harness/hollow.py) is fired at its wall deadlines against a
+    live serving scheduler (harness/perf.py SustainedLoadRunner — the
+    coordinated-omission defense: offered rate fixed by the stream,
+    completed rate measured separately), while the windowed telemetry
+    ring (kubetpu/utils/telemetry.py) records per-window e2e quantiles.
+    The verdict is the STEADY-STATE windowed p99 — warmup cut by the
+    slope test, never averaged in.
+
+    Two phases, both gated under BENCH_GATE=1:
+      1. parity — the same seeded stream drained synchronously with the
+         ring armed vs disarmed must produce bit-identical placements
+         (telemetry is write-only observability, never a policy input);
+      2. measured — after a short warmup drain pays the compiles, the
+         open-loop stream runs for duration_s with window_s-second
+         telemetry windows.  The gate demands >= 6 steady-state windows,
+         ZERO recovery-ladder demotions, and offered-vs-completed within
+         5%; the steady p99 lands in NORTHSTAR.json as a seconds
+         ceiling."""
+    from kubetpu.api import types as kapi
+    from kubetpu.apis.config import (KubeSchedulerConfiguration,
+                                     KubeSchedulerProfile)
+    from kubetpu.client.store import ClusterStore
+    from kubetpu.harness import hollow
+    from kubetpu.harness.perf import SustainedLoadRunner
+    from kubetpu.scheduler import Scheduler
+    from kubetpu.utils import telemetry as utelemetry
+
+    rate = float(os.environ.get("BENCH_SUSTAINED_RATE", rate or 8.0))
+    duration_s = float(os.environ.get("BENCH_SUSTAINED_S",
+                                      duration_s or 12.0))
+    window_s = float(os.environ.get("BENCH_SUSTAINED_WINDOW",
+                                    window_s or 1.0))
+
+    # The measured stream is seeded, so its exact add count is known
+    # up front — sizing below is exact, not statistical
+    warm_sizes = (1, 2, 4, 8, 16, 32)
+    events = hollow.poisson_stream(rate, duration_s, seed=11)
+    n_meas = sum(1 for e in events if e["kind"] == "add")
+    # pod-axis pow2 ceiling: fill pins the bucket (fill+1 must already
+    # pad to it), and BOTH the warmup drip (warm pods resident) and the
+    # measured stream (warm pods deleted) must finish under it.  Keeping
+    # the ceiling SMALL matters as much as not crossing it: bucket-2048
+    # programs cost seconds per dispatch on CPU, stretching the
+    # tick-piggybacked windows until the slope test can never converge.
+    need = max(n_meas + 16, sum(warm_sizes) + 32) + 8
+    ceil_pow = 1 << (2 * need - 1).bit_length()
+    fill = ceil_pow // 2 + 8
+
+    def make_world(fill=0):
+        store = ClusterStore()
+        nodes = hollow.make_nodes(n_nodes, zones=8)
+        for n in nodes:
+            store.add(n)
+        # bound filler pods enter the cluster tensor WITHOUT being
+        # scheduled: they pin the pod-axis pow2 pad bucket above the
+        # range warmup + stream traverse, so the measured phase never
+        # pays a mid-run bucket recompile (the stall class
+        # Scheduler._prewarm_ladder exists for, contained statically —
+        # every program the open-loop cycles need is compiled before
+        # the first measured window)
+        for i in range(fill):
+            p = hollow.make_pod(f"fill-{i}",
+                                labels={"app": f"app-{i % 16}"})
+            # heavier spread share than the stream (25%): the fill
+            # pins the TERM-axis pad bucket too, so stream spread pods
+            # can't grow the constraint surface across a pow2 edge
+            if i % 2 == 0:
+                hollow.with_spread(p, kapi.LABEL_ZONE,
+                                   when="ScheduleAnyway")
+            p.spec.node_name = nodes[i % len(nodes)].name
+            store.add(p)
+        cfg = KubeSchedulerConfiguration(
+            profiles=[KubeSchedulerProfile()],
+            batch_size=256, mode="gang", chain_cycles=False)
+        return store, cfg
+
+    # -- phase 1: armed-vs-disarmed parity on a deterministic drain.
+    # The stream is regenerated from the same seed per run (binding
+    # mutates pod.spec.node_name in place, so the two drains must not
+    # share pod objects); open-loop timing is nondeterministic, so
+    # parity uses synchronous injection of the identical pod set.
+    def parity_drain(arm):
+        # arm_telemetry is idempotent (returns any existing ring), so
+        # drop the bench-global 5 s ring before arming at a tick-heavy
+        # 50 ms window
+        utelemetry.disarm_telemetry()
+        if arm:
+            utelemetry.arm_telemetry(window_s=0.05)
+        try:
+            store, cfg = make_world()
+            sched = Scheduler(store, config=cfg, async_binding=False)
+            sched.device_wait_s = 0.0
+            for e in hollow.poisson_stream(rate, 8.0, seed=7):
+                if e["kind"] == "add":
+                    store.add(e["pod"])
+            placements = {}
+            while True:
+                got = sched.schedule_pending(timeout=0.2)
+                if not got:
+                    break
+                for o in got:
+                    placements[o.pod.metadata.name] = o.node
+            sched.close()
+            return placements
+        finally:
+            utelemetry.disarm_telemetry()
+
+    p_armed = parity_drain(True)
+    p_plain = parity_drain(False)
+    parity = bool(p_armed) and p_armed == p_plain
+
+    # -- phase 2: the measured open-loop run.  The SLO tracker resets
+    # FIRST so its cumulative stage shares (the latency block benchtrend
+    # attributes regressions to) describe this case alone; the fresh
+    # ring is armed after, so its first window's delta baseline is the
+    # cleared tracker
+    slo_trk = _slo_tracker()
+    if slo_trk is not None:
+        slo_trk.clear()
+    store, cfg = make_world(fill=fill)
+    utelemetry.disarm_telemetry()
+    utelemetry.arm_telemetry(window_s=window_s)
+    sched = Scheduler(store, config=cfg, async_binding=True)
+    sched.run()                 # base prewarm rides startup (run())
+    try:
+        # warmup drip: the live serving loop pays each pow2
+        # incoming-batch bucket (1..32) the open-loop cycles will hit —
+        # one group at a time, each bound before the next is offered —
+        # so the measured stream meets only compiled programs and the
+        # steady-state slope test converges inside a CPU-scale run.
+        # Warmup windows stay in the ring; the slope test cuts them.
+        warm_pool = [e["pod"] for e in hollow.poisson_stream(
+            rate, 4.0 * sum(warm_sizes) / rate, seed=3, prefix="warm-")
+            if e["kind"] == "add"]
+        warm = []
+        t_warm = time.time()
+        deadline = t_warm + 300.0
+        for k in warm_sizes:
+            if len(warm_pool) < len(warm) + k:
+                break
+            group = warm_pool[len(warm):len(warm) + k]
+            for p in group:
+                store.add(p)
+            warm.extend(group)
+            while time.time() < deadline:
+                if all((store.get_pod(p.namespace, p.metadata.name)
+                        or p).spec.node_name for p in group):
+                    break
+                time.sleep(0.05)
+        # warm pods leave before the measured phase so the stream's
+        # arrivals refill the same pod-count range the drip traversed —
+        # fill + n_meas stays under ceil_pow and the pod-axis bucket
+        # never moves
+        for p in warm:
+            cur = store.get_pod(p.namespace, p.metadata.name)
+            if cur is not None:
+                store.delete(cur)
+        warm_s = time.time() - t_warm
+        res = SustainedLoadRunner(store, sched, events, duration_s,
+                                  settle_s=30.0).run()
+    finally:
+        sched.close()
+        utelemetry.disarm_telemetry()
+
+    load = res.get("load") or {}
+    steady = load.get("steady") or {}
+    out = {
+        "nodes": n_nodes, "rate": rate, "window_s": window_s,
+        "stream": "poisson", "fill_pods": fill,
+        "warmup_pods": len(warm), "warmup_s": round(warm_s, 2),
+        "placements_match": parity,
+        # the gate quartet: steady span, steady p99 (ceiling), zero
+        # demotions, offered-vs-completed
+        "steady_windows": int(steady.get("windows", 0)),
+        "steady_p99_s": steady.get("p99_s"),
+        "steady_p50_s": steady.get("p50_s"),
+        "demotions": int(load.get("demotions", 0)),
+        "journal_armed": _journal_armed(),
+    }
+    latency = _latency_block(slo_trk)
+    if latency is not None:
+        out["latency"] = latency
+    out.update(res)
+    return out
 
 
 def _restart_once(n_nodes, existing_per_node, wave, ladder, timer):
@@ -1501,6 +1729,13 @@ def main() -> None:
     # in HBM, and the per-case "device" block carries the roofline join
     from kubetpu.utils import devstats as udevstats
     udevstats.arm_devstats()
+    # ...and the windowed sustained-load telemetry ring
+    # (kubetpu/utils/telemetry.py): per-window stage quantiles / queue
+    # depths / recovery events at the default 5 s cadence across every
+    # case, so the pipeline doc gains the "load" section traceview
+    # digests (the sustained_load case re-arms at its own finer window)
+    from kubetpu.utils import telemetry as utelemetry
+    utelemetry.arm_telemetry()
 
     detail = {"backend": jax.default_backend(), "pending": n_pods,
               "nodes": n_nodes}
@@ -1613,6 +1848,12 @@ def main() -> None:
             detail["replay_fidelity"] = replay_fidelity_case()
         except Exception as e:  # pragma: no cover - depends on device state
             detail["replay_fidelity"] = {"error": repr(e)}
+
+    if os.environ.get("BENCH_SUSTAINED", "1") == "1" and mesh_shape is None:
+        try:
+            detail["sustained_load"] = sustained_load_case()
+        except Exception as e:  # pragma: no cover - depends on device state
+            detail["sustained_load"] = {"error": repr(e)}
 
     if full:
         northstar = {}

@@ -49,6 +49,7 @@ from .utils import chaos as uchaos
 from .utils import devstats as udevstats
 from .utils import journal as ujournal
 from .utils import slo as uslo
+from .utils import telemetry as utelemetry
 from .utils import trace as utrace
 from .utils.decisions import DecisionLog, PodDecision
 from .utils.trace import Trace
@@ -184,6 +185,11 @@ class Scheduler:
         # every seam is one attribute read and placements are
         # bit-identical armed vs disarmed (tests/test_devstats.py)
         udevstats.maybe_arm_from_env()
+        # KUBETPU_TELEMETRY: arm the windowed sustained-load telemetry
+        # ring (utils/telemetry.py) — the serving loop rolls one window
+        # record per KUBETPU_TELEMETRY_WINDOW seconds; disarmed, the
+        # tick seam is one attribute read (tests/test_telemetry.py)
+        utelemetry.maybe_arm_from_env()
         import jax
         self.store = store
         self.config = config or KubeSchedulerConfiguration(
@@ -520,6 +526,12 @@ class Scheduler:
         """Run ONE batched scheduling cycle: pop up to batch_size pods and
         schedule them.  Returns outcomes (the test/introspection surface).
         The serving loop (run/serve_forever) just calls this repeatedly."""
+        # telemetry tick seam: disarmed this is ONE attribute read (the
+        # house contract); armed, the deadline check is one float
+        # compare and a roll happens once per window, not per cycle
+        tel = utelemetry.ring()
+        if tel is not None:
+            tel.maybe_tick(self)
         max_batch = max_batch or self.config.batch_size
         if self.extenders:
             # extenders are a per-pod HTTP round trip; keep the reference's

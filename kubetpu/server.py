@@ -21,7 +21,11 @@ KUBETPU_JOURNAL is unset).  ``/debug/devicez`` serves device-side
 observability (utils/devstats.py: measured per-program device time with
 the roofline join, the HBM residency ledger, fence-overhead accounting;
 404 while KUBETPU_DEVSTATS is disarmed, ``?program=`` filters, unknown
-programs are 400).
+programs are 400).  ``/debug/loadz`` serves the sustained-load telemetry
+ring (utils/telemetry.py: per-window stage quantiles, queue depths,
+recovery/demotion events, journal/flight drops, device deltas, plus the
+steady-state digest; 404 while KUBETPU_TELEMETRY is disarmed, ``?n=``
+limits to the newest n windows, bad parameters are 400).
 """
 
 from __future__ import annotations
@@ -36,6 +40,7 @@ from typing import Optional
 from .utils import devstats as udevstats
 from .utils import journal as ujournal
 from .utils import slo as uslo
+from .utils import telemetry as utelemetry
 from .utils import trace as utrace
 
 
@@ -168,6 +173,28 @@ class SchedulerServer:
                     doc["programs"] = {program: doc["programs"][program]}
                 self._send_json(200, doc)
 
+            def _loadz(self, query) -> None:
+                tel = utelemetry.ring()
+                if tel is None:
+                    self._send_json(404, {
+                        "armed": False,
+                        "error": "the telemetry ring is disarmed",
+                        "hint": "arm with KUBETPU_TELEMETRY=1 or "
+                                "kubetpu.utils.telemetry.arm_telemetry()"})
+                    return
+                raw_n = (query.get("n") or [None])[0]
+                last = None
+                if raw_n is not None:
+                    try:
+                        last = int(raw_n)
+                        if last < 0:
+                            raise ValueError
+                    except ValueError:
+                        self._send_json(400, {
+                            "error": "n must be a non-negative integer"})
+                        return
+                self._send_json(200, tel.to_dict(last=last))
+
             def _journal(self, query) -> None:
                 jr = ujournal.journal()
                 if jr is None:
@@ -217,6 +244,8 @@ class SchedulerServer:
                     self._journal(query)
                 elif path == "/debug/devicez":
                     self._devicez(query)
+                elif path == "/debug/loadz":
+                    self._loadz(query)
                 else:
                     self._send(404, "not found")
 

@@ -237,6 +237,20 @@ class SloTracker:
         with self._lock:
             return [dict(e) for e in self._exemplars]
 
+    def counts_snapshot(self) -> Dict[str, Any]:
+        """Cumulative raw bucket counts per stage (copies, safe to keep)
+        + pod totals, in ONE locked read — the telemetry ring
+        (utils/telemetry.py) subtracts two of these one window apart to
+        get exact per-window quantiles over the same ladder."""
+        with self._lock:
+            return {
+                "stages": {name: {"counts": sk.counts.copy(),
+                                  "sum_s": sk.sum_s}
+                           for name, sk in self._sketches.items()},
+                "pods": self._pods,
+                "unresolvable": self._unresolvable,
+            }
+
     def to_dict(self, quantiles=(0.5, 0.9, 0.99, 0.999)) -> Dict[str, Any]:
         """The /debug/slo document."""
         with self._lock:

@@ -403,6 +403,16 @@ class FlightRecorder:
         ds = _devstats.devstats()
         if ds is not None:
             doc["device"] = ds.summary()
+        # sustained-load digest (utils/telemetry.py): when the windowed
+        # telemetry ring is armed alongside the recorder, the pipeline
+        # doc carries its digest — window count/cadence, steady-state
+        # span + p99, demotions, worst window with flight_seq link — so
+        # traceview can print the "load:" digest from the committed
+        # artifact alone
+        from . import telemetry as _telemetry
+        tel = _telemetry.ring()
+        if tel is not None:
+            doc["load"] = tel.digest()
         return doc
 
     @staticmethod

@@ -436,3 +436,34 @@ def test_permit_wait_and_preemption_metrics_wired():
         assert m2.preemption_victims.count() >= 1
     finally:
         sched2.close()
+
+
+def test_stage_histograms_on_metrics(slo):
+    """The armed SLO tracker's per-stage ladders render as REAL
+    Prometheus histograms on /metrics: cumulative le monotonicity,
+    +Inf == _count, a _sum per stage — and zero lines disarmed (the
+    byte-identical degrade-to-nothing contract)."""
+    m = SchedulerMetrics()
+    store, sched = _world(metrics=m)
+    try:
+        _drain(sched)
+        body = m.expose_text()
+        name = "scheduler_pod_stage_duration_seconds"
+        assert f"# TYPE {name} histogram" in body
+        import re
+        for stage in ("e2e", "bind", "queue_wait"):
+            pat = re.compile(
+                name + r'_bucket\{stage="' + stage +
+                r'",le="([^"]+)"\} (\d+)')
+            buckets = [(le, int(n)) for le, n in pat.findall(body)]
+            assert buckets and buckets[-1][0] == "+Inf"
+            counts = [n for _, n in buckets]
+            assert counts == sorted(counts)          # cumulative
+            cnt = re.search(
+                name + r'_count\{stage="' + stage + r'"\} (\d+)', body)
+            assert cnt and int(cnt.group(1)) == buckets[-1][1] == 6
+            assert f'{name}_sum{{stage="{stage}"}}' in body
+        uslo.disarm_slo_tracker()
+        assert name not in m.expose_text()
+    finally:
+        sched.close()

@@ -42,9 +42,12 @@ from typing import Any, Dict, List, Optional, Tuple
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # dotted case -> the numeric field the trend table tracks (first match
-# wins; cases carrying neither are skipped)
+# wins; cases carrying neither are skipped).  steady_p99_s is the
+# sustained_load case's windowed steady-state pod e2e p99
+# (kubetpu/utils/telemetry.py) — a seconds row like the restart SLOs.
 THROUGHPUT_KEYS = ("pods_per_sec",)
-SECONDS_KEYS = ("e2e_best_s", "e2e_s", "restart_s", "cold_restart_s")
+SECONDS_KEYS = ("e2e_best_s", "e2e_s", "restart_s", "cold_restart_s",
+                "steady_p99_s")
 
 
 def _find_detail(doc) -> Optional[Dict[str, Any]]:
@@ -221,6 +224,15 @@ def attribute_regression(prev: Dict[str, Any],
     if (isinstance(pd0, (int, float)) and isinstance(pd1, (int, float))
             and pd0 != pd1):
         note += f"pipeline_depth changed {int(pd0)} -> {int(pd1)}; "
+    # recovery-path growth is named BEFORE stage shares: on the
+    # sustained_load case (and node_flap) a steady-state p99 regression
+    # that coincides with the recovery ladder firing more often is a
+    # resilience-path regression, not a hot-path one
+    for key in ("demotions", "recoveries"):
+        r0, r1 = prev.get(key), cur.get(key)
+        if (isinstance(r0, (int, float)) and isinstance(r1, (int, float))
+                and r1 > r0):
+            note += f"{key} grew {int(r0)} -> {int(r1)}; "
     dev = device_attribution(prev, cur)
     dev = ("; " + dev) if dev else ""
     ps = (prev.get("latency") or {}).get("stage_shares") or {}
@@ -359,6 +371,15 @@ def northstar_check(rounds: List[Dict[str, Any]]
     detail["warm_restart"] = {
         k: v for k, v in (latest["detail"].get("warm_restart") or {}).items()
         if k != "placements_match"}
+    # same discipline for the sustained-load contract: the live-run
+    # quartet (parity, steady span, demotions, completed_frac) gates
+    # BENCH_GATE=1 runs; committed history only trends the steady-p99
+    # ceiling
+    detail["sustained_load"] = {
+        k: v
+        for k, v in (latest["detail"].get("sustained_load") or {}).items()
+        if k not in ("placements_match", "steady_windows", "demotions",
+                     "completed_frac")}
     failures = northstar_gate(detail, path=path)
     try:
         with open(path) as f:

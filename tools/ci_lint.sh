@@ -34,10 +34,13 @@ python -m tools.kubelint kubetpu/ --json
 # The shard_map mesh module (kubetpu/parallel/shardmap.py) joins it:
 # its trace-time Mesh registry is guarded-by annotated and read only at
 # trace time (never under a traced computation)
+# The telemetry ring (utils/telemetry.py) joins it: its window deque is
+# guarded-by annotated, the roll gathers run under a separate roll lock
+# (never the ring lock), and the disarmed hot path takes zero locks
 python -m tools.kubelint kubetpu/utils/trace.py kubetpu/utils/decisions.py \
 	kubetpu/utils/chaos.py kubetpu/utils/slo.py kubetpu/pipeline.py \
 	kubetpu/utils/journal.py kubetpu/utils/devstats.py \
-	kubetpu/parallel/shardmap.py \
+	kubetpu/parallel/shardmap.py kubetpu/utils/telemetry.py \
 	--rules concurrency --json
 # explicit delta-family pass over the serving loop: the cycle path must
 # stay scatter-only (full-retensorize-in-loop), independent of any
@@ -118,6 +121,15 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
 # armed-vs-disarmed placement-parity golden.
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
 	tests/test_slo.py -q -m 'not slow' -p no:cacheprovider
+# Sustained-load telemetry plane (utils/telemetry.py + the open-loop
+# harness streams in kubetpu/harness/hollow.py + perf.py's
+# SustainedLoadRunner): window-delta merge exactness vs the numpy order
+# statistic, ring wrap + drop counting, the disarmed zero-cost poison
+# test, the armed-vs-disarmed placement-parity golden, seeded
+# chaos-storm attribution to the firing window, /debug/loadz round
+# trip, and the /metrics scheduler_load_* window series.
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
+	tests/test_telemetry.py -q -m 'not slow' -p no:cacheprovider
 # Depth-k pipelined executor (kubetpu/pipeline.py): depth-parity
 # placement goldens (depth 1 == 2 == 4 bit-identical), the
 # gather-window/free-slot gate, per-slot exemption accounting, ring-slot

@@ -229,6 +229,42 @@ def device_summary(doc) -> str:
     return "device: " + " | ".join(parts)
 
 
+def load_summary(doc) -> str:
+    """One-line sustained-load digest under the stage table: window
+    count and cadence, the steady-state span with its EXACT windowed
+    p50/p99 (warmup cut by the slope test), total recovery demotions,
+    and the worst window's p99 with its flight-recorder seq cross-link —
+    read from the "load" block the pipeline doc carries when the
+    KUBETPU_TELEMETRY ring was armed for the run
+    (kubetpu/utils/telemetry.py; live twin at /debug/loadz)."""
+    ld = doc.get("load")
+    if not isinstance(ld, dict) or not ld.get("windows"):
+        return ""
+
+    def ms(v):
+        return f"{1000 * v:.1f}ms" if v < 1.0 else f"{v:.2f}s"
+
+    parts = [f"{ld['windows']} windows x {ld.get('window_s', 0.0):g}s"
+             + (f" ({ld['dropped']} dropped)" if ld.get("dropped")
+                else "")]
+    steady = ld.get("steady")
+    if isinstance(steady, dict):
+        parts.append(f"steady [{steady.get('start', 0)}+"
+                     f"{steady.get('windows', 0)}] "
+                     f"p50 {ms(steady.get('p50_s', 0.0))} "
+                     f"p99 {ms(steady.get('p99_s', 0.0))}")
+    else:
+        parts.append("no steady state reached")
+    if ld.get("demotions"):
+        parts.append(f"{ld['demotions']} demotions")
+    worst = ld.get("worst_window")
+    if isinstance(worst, dict) and worst.get("p99_s"):
+        parts.append(f"worst w{worst.get('seq', 0)} "
+                     f"p99 {ms(worst['p99_s'])} "
+                     f"(flight seq {worst.get('flight_seq', 0)})")
+    return "load: " + ", ".join(parts)
+
+
 def pipeline_summary(doc) -> str:
     """One-line depth-k pipeline digest under the stage table: the
     configured depth plus the ring-slot occupancy histogram (slot ->
@@ -317,6 +353,9 @@ def main(argv=None) -> int:
     jnl = journal_summary(doc)
     if jnl:
         print(jnl)
+    ld = load_summary(doc)
+    if ld:
+        print(ld)
     if not spans:
         return 0
     wall: Dict[int, float] = {}
